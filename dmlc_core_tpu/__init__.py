@@ -1,0 +1,35 @@
+"""dmlc_core_tpu — a TPU-native data substrate with the capabilities of dmlc-core.
+
+Brand-new design (not a port) providing, TPU-first:
+
+- ``utils``       : logging/CHECK, typed env access, timers, thread-safe helpers
+                    (reference: include/dmlc/logging.h, timer.h, common.h)
+- ``params``      : declarative Parameter structs, Registry plugin system, Config
+                    file parser (reference: include/dmlc/parameter.h, registry.h,
+                    config.h)
+- ``io``          : URI-addressed Stream/FileSystem abstraction, RecordIO codec,
+                    record-aligned sharded InputSplits (reference: include/dmlc/io.h,
+                    recordio.h, src/io/)
+- ``data``        : sparse RowBlocks as contiguous numpy CSR, multi-threaded
+                    libsvm/csv/libfm parsers, row iterators (reference:
+                    include/dmlc/data.h, src/data/)
+- ``concurrency`` : ThreadedIter-style bounded prefetch pipelines with
+                    cross-thread exception propagation (reference:
+                    include/dmlc/threadediter.h, concurrency.h, thread_group.h)
+- ``staging``     : the TPU-native layer — fixed-shape batching of ragged
+                    RowBlocks and double-buffered staging into TPU HBM as XLA
+                    device buffers (new; no reference analogue)
+- ``models``/``ops``/``parallel`` : jitted downstream-learner examples (sparse
+                    linear/logistic/FM) with SPMD sharding over a jax Mesh —
+                    what rabit/ps-lite learners are to the reference
+- ``tracker``     : dmlc-submit compatible launcher: rank rendezvous tracker,
+                    tree+ring topology, cluster backends incl. ``tpu-pod``
+                    (reference: tracker/dmlc_tracker/)
+
+The native C++ fast path for parsing/RecordIO lives in ``native/`` and is loaded
+via ctypes when available; every component has a pure-Python/numpy fallback.
+"""
+
+__version__ = "0.1.0"
+
+from . import utils  # noqa: F401

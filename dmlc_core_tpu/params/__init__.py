@@ -1,0 +1,6 @@
+"""Declarative parameters, registries, and config files (reference:
+include/dmlc/parameter.h, registry.h, config.h)."""
+
+from .parameter import Parameter, field, ParamError  # noqa: F401
+from .registry import Registry, RegistryEntry  # noqa: F401
+from .config import Config  # noqa: F401

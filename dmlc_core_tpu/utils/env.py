@@ -1,0 +1,40 @@
+"""Typed environment variable access.
+
+Reference: dmlc::GetEnv/SetEnv (include/dmlc/parameter.h:1068-1096). The env
+is the cross-process config channel of the DMLC_* launcher contract
+(SURVEY §2.6), so typed access lives in utils where both the data layer and
+the tracker can reach it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Type, TypeVar, Union
+
+from .common import parse_bool
+
+T = TypeVar("T", bound=Union[int, float, str, bool])
+
+
+def get_env(key: str, default: T) -> T:
+    """Read env var ``key`` converted to the type of ``default``.
+
+    bool accepts 0/1/true/false/yes/no/on/off case-insensitively (the
+    reference only handles int-ish bools via C++ stream extraction; we are
+    deliberately laxer but strict about unrecognized strings).
+    """
+    raw = os.environ.get(key)
+    if raw is None:
+        return default
+    ty: Type = type(default)
+    if ty is bool:
+        return parse_bool(raw)  # type: ignore[return-value]
+    return ty(raw)  # type: ignore[return-value]
+
+
+def set_env(key: str, value: Union[int, float, str, bool]) -> None:
+    """Set env var ``key``; bools are written as 1/0 for the C++ side."""
+    if isinstance(value, bool):
+        os.environ[key] = "1" if value else "0"
+    else:
+        os.environ[key] = str(value)

@@ -1,0 +1,169 @@
+"""Logging and CHECK machinery.
+
+TPU-native rethink of the reference's minimal-glog (reference:
+include/dmlc/logging.h:205-280,408-435). Python exceptions replace the
+LogMessageFatal-throws-dmlc::Error trick natively; we keep:
+
+- ``Error``: the framework exception type (reference logging.h:29-35).
+- ``check*``: CHECK/CHECK_EQ/... equivalents that raise ``Error`` with both
+  operands in the message (reference logging.h:205-216).
+- severity log functions with timestamped stderr lines (reference
+  logging.h:315-338).
+- a pluggable sink, like DMLC_LOG_CUSTOMIZE / CustomLogMessage::Log
+  (reference logging.h:341-360).
+- debug logging gated by the DMLC_LOG_DEBUG env var (reference
+  logging.h:131-146).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+__all__ = [
+    "Error",
+    "check",
+    "check_eq",
+    "check_ne",
+    "check_lt",
+    "check_le",
+    "check_gt",
+    "check_ge",
+    "check_notnull",
+    "log_info",
+    "log_warning",
+    "log_error",
+    "log_fatal",
+    "log_debug",
+    "set_log_sink",
+    "debug_logging_enabled",
+]
+
+
+class Error(RuntimeError):
+    """Framework error type; all CHECK failures raise this.
+
+    Reference: dmlc::Error, include/dmlc/logging.h:29-35. When
+    DMLC_LOG_STACK_TRACE is on the reference appends a backtrace
+    (logging.h:65-83); Python tracebacks subsume that.
+    """
+
+
+# Pluggable sink: receives (severity:str, message:str). Default writes a
+# timestamped line to stderr, like LogMessage (reference logging.h:315-338).
+_log_sink: Optional[Callable[[str, str], None]] = None
+
+
+def set_log_sink(sink: Optional[Callable[[str, str], None]]) -> None:
+    """Redirect log output, like DMLC_LOG_CUSTOMIZE (reference logging.h:341-360).
+
+    Pass None to restore the default stderr sink.
+    """
+    global _log_sink
+    _log_sink = sink
+
+
+def _emit(severity: str, msg: str) -> None:
+    if _log_sink is not None:
+        _log_sink(severity, msg)
+        return
+    now = time.localtime()
+    stamp = time.strftime("%H:%M:%S", now)
+    sys.stderr.write(f"[{stamp}] {severity} {msg}\n")
+
+
+def debug_logging_enabled() -> bool:
+    """DMLC_LOG_DEBUG env gate (reference logging.h:131-146).
+
+    Same truthy set as utils.common.parse_bool (inlined: common imports from
+    this module, so importing back would cycle); unrecognized strings count
+    as enabled rather than erroring — logging must never throw on config.
+    """
+    return os.environ.get("DMLC_LOG_DEBUG", "0").strip().lower() not in (
+        "",
+        "0",
+        "false",
+        "no",
+        "off",
+    )
+
+
+def log_info(msg: str) -> None:
+    _emit("INFO", msg)
+
+
+def log_warning(msg: str) -> None:
+    _emit("WARNING", msg)
+
+
+def log_error(msg: str) -> None:
+    _emit("ERROR", msg)
+
+
+def log_debug(msg: str) -> None:
+    if debug_logging_enabled():
+        _emit("DEBUG", msg)
+
+
+def log_fatal(msg: str) -> None:
+    """LOG(FATAL): emit and raise Error (reference logging.h:408-435)."""
+    _emit("FATAL", msg)
+    raise Error(msg)
+
+
+def _fail(op: str, x: Any, y: Any, msg: str) -> None:
+    detail = f"Check failed: {x!r} {op} {y!r}"
+    if msg:
+        detail += f": {msg}"
+    raise Error(detail)
+
+
+def check(cond: Any, msg: str = "") -> None:
+    """CHECK(cond) (reference logging.h:205-216)."""
+    if not cond:
+        raise Error(f"Check failed: {msg}" if msg else "Check failed")
+
+
+def check_eq(x: Any, y: Any, msg: str = "") -> None:
+    if not (x == y):
+        _fail("==", x, y, msg)
+
+
+def check_ne(x: Any, y: Any, msg: str = "") -> None:
+    if not (x != y):
+        _fail("!=", x, y, msg)
+
+
+def check_lt(x: Any, y: Any, msg: str = "") -> None:
+    if not (x < y):
+        _fail("<", x, y, msg)
+
+
+def check_le(x: Any, y: Any, msg: str = "") -> None:
+    if not (x <= y):
+        _fail("<=", x, y, msg)
+
+
+def check_gt(x: Any, y: Any, msg: str = "") -> None:
+    if not (x > y):
+        _fail(">", x, y, msg)
+
+
+def check_ge(x: Any, y: Any, msg: str = "") -> None:
+    if not (x >= y):
+        _fail(">=", x, y, msg)
+
+
+def check_notnull(x: Any, msg: str = "") -> Any:
+    """CHECK_NOTNULL (reference logging.h:218)."""
+    if x is None:
+        raise Error(f"Check notnull failed: {msg}" if msg else "Check notnull failed")
+    return x
+
+
+def format_exception(exc: BaseException) -> str:
+    """Render an exception with traceback, used when relaying worker errors."""
+    return "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))

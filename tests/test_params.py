@@ -1,0 +1,153 @@
+"""Tests for Parameter/Registry/Config.
+
+Modeled on reference test/unittest/unittest_param.cc, unittest_config.cc and
+example/parameter.cc.
+"""
+
+import pytest
+
+from dmlc_core_tpu.params import Config, ParamError, Parameter, Registry, field
+
+
+class LearnParam(Parameter):
+    num_hidden = field(int, default=64, lower=1, help="number of hidden units")
+    lr = field(float, default=0.1, lower=0.0, upper=10.0, aliases=("learning_rate",))
+    name = field(str, default="net")
+    act = field(str, default="relu", enum={"relu": "relu", "sigmoid": "sigmoid"})
+    verbose = field(bool, default=False)
+
+
+def test_param_defaults_and_init():
+    p = LearnParam()
+    assert p.num_hidden == 64 and p.lr == 0.1 and p.act == "relu"
+    p.init({"num_hidden": "128", "lr": "0.5", "verbose": "true"})
+    assert p.num_hidden == 128 and p.lr == 0.5 and p.verbose is True
+
+
+def test_param_range_check():
+    p = LearnParam()
+    with pytest.raises(ParamError, match="out of range"):
+        p.init({"num_hidden": 0})
+    with pytest.raises(ParamError, match="out of range"):
+        p.init({"lr": 100.0})
+
+
+def test_param_enum_and_alias():
+    p = LearnParam(act="sigmoid", learning_rate=0.9)
+    assert p.act == "sigmoid" and p.lr == 0.9
+    with pytest.raises(ParamError, match="expected one of"):
+        p.init({"act": "softmax"})
+
+
+def test_param_unknown_key_suggestion():
+    p = LearnParam()
+    with pytest.raises(ParamError, match="num_hidden"):
+        p.init({"num_hiden": 3})  # typo → did-you-mean
+    leftover = p.init({"totally_new": 1}, allow_unknown=True)
+    assert leftover == {"totally_new": 1}
+
+
+def test_param_dict_json_doc_roundtrip():
+    p = LearnParam(num_hidden=5)
+    d = p.to_dict()
+    assert d["num_hidden"] == "5"
+    q = LearnParam()
+    q.load_json(p.save_json())
+    assert q == p
+    doc = LearnParam.doc()
+    assert "num_hidden" in doc and "hidden units" in doc
+
+
+def test_param_bad_type():
+    p = LearnParam()
+    with pytest.raises(ParamError, match="Invalid value"):
+        p.init({"num_hidden": "not_an_int"})
+
+
+def test_registry_basic():
+    reg = Registry("test_kind")
+    try:
+
+        @reg.register("alpha")
+        def make_alpha(x):
+            return ("alpha", x)
+
+        entry = reg.lookup("alpha").describe("the alpha factory")
+        assert entry.description == "the alpha factory"
+        assert reg.create("alpha", 3) == ("alpha", 3)
+        assert reg.find("missing") is None
+        with pytest.raises(Exception, match="already registered"):
+            reg.add("alpha", make_alpha)
+        reg.add("alpha", lambda x: ("alpha2", x), override=True)
+        assert reg.create("alpha", 1) == ("alpha2", 1)
+        assert Registry.get("test_kind") is reg
+    finally:
+        Registry._instances.pop("test_kind", None)
+
+
+def test_config_parse():
+    text = """
+    # a comment
+    lr = 0.1
+    name = "hello world" # trailing
+    esc = "a\\"b\\nc"
+    n = 3
+    """
+    cfg = Config(text)
+    assert cfg.get("lr") == "0.1"
+    assert cfg.get("name") == "hello world"
+    assert cfg.get("esc") == 'a"b\nc'
+    assert cfg.get("n") == "3"
+    assert "lr" in cfg and "missing" not in cfg
+
+
+def test_config_multi_value_and_order():
+    cfg = Config(multi_value=True)
+    cfg.load("k = 1\nk = 2\nj = x\n")
+    assert cfg.get_all("k") == ["1", "2"]
+    assert [kv for kv in cfg] == [("k", "1"), ("k", "2"), ("j", "x")]
+    single = Config("k = 1\nk = 2\n")
+    assert single.get_all("k") == ["2"]
+
+
+def test_config_proto_string():
+    cfg = Config('a = "x\\ny"\n')
+    assert cfg.to_proto_string() == 'a : "x\\ny"\n'
+
+
+def test_config_errors():
+    with pytest.raises(Exception):
+        Config("= 1")
+    with pytest.raises(Exception):
+        Config('k = "unterminated')
+    with pytest.raises(Exception):
+        Config("k 1")
+    with pytest.raises(Exception):
+        Config("a = = \nb = c")  # '=' may not be a value
+    with pytest.raises(Exception):
+        Config("= = x")  # '=' may not be a key
+
+
+def test_param_optional_none_roundtrip():
+    class OptParam(Parameter):
+        x = field(int, default=None)
+        s = field(str, default=None)
+
+    p = OptParam()
+    q = OptParam()
+    q.load_json(p.save_json())
+    assert q.x is None and q.s is None
+    p.init({"x": 3})
+    q.load_json(p.save_json())
+    assert q.x == 3 and q.s is None
+
+
+def test_param_required_enforced_with_nonnull_default():
+    class ReqParam(Parameter):
+        path = field(str, default="", required=True)
+
+    with pytest.raises(ParamError, match="Required parameter"):
+        ReqParam().init({})
+    p = ReqParam()
+    p.init({"path": "x"})
+    assert p.path == "x"

@@ -1,0 +1,98 @@
+"""Tests for utils: logging/CHECK, env, common helpers.
+
+Modeled on reference test/unittest/unittest_logging.cc and unittest_env.cc.
+"""
+
+import os
+import threading
+
+import pytest
+
+from dmlc_core_tpu.utils import (
+    Error,
+    check,
+    check_eq,
+    check_lt,
+    check_notnull,
+    get_env,
+    set_env,
+    hash_combine,
+    split_string,
+    log_fatal,
+    set_log_sink,
+    ThreadException,
+)
+from dmlc_core_tpu.utils.common import run_parallel
+
+
+def test_check_raises_error():
+    check(True)
+    with pytest.raises(Error):
+        check(False, "boom")
+    with pytest.raises(Error, match="=="):
+        check_eq(1, 2)
+    check_eq(3, 3)
+    with pytest.raises(Error):
+        check_lt(5, 5)
+    assert check_notnull("x") == "x"
+    with pytest.raises(Error):
+        check_notnull(None)
+
+
+def test_log_fatal_raises_and_sink_captures():
+    captured = []
+    set_log_sink(lambda sev, msg: captured.append((sev, msg)))
+    try:
+        with pytest.raises(Error, match="die"):
+            log_fatal("die")
+    finally:
+        set_log_sink(None)
+    assert captured == [("FATAL", "die")]
+
+
+def test_typed_env_roundtrip():
+    # reference unittest_env.cc pattern: set then typed get
+    set_env("DMLC_TPU_TEST_INT", 42)
+    assert get_env("DMLC_TPU_TEST_INT", 0) == 42
+    set_env("DMLC_TPU_TEST_BOOL", True)
+    assert get_env("DMLC_TPU_TEST_BOOL", False) is True
+    os.environ["DMLC_TPU_TEST_BOOL"] = "false"
+    assert get_env("DMLC_TPU_TEST_BOOL", True) is False
+    assert get_env("DMLC_TPU_TEST_MISSING", 1.5) == 1.5
+    assert get_env("DMLC_TPU_TEST_INT", "z") == "42"
+
+
+def test_split_and_hash_combine():
+    assert split_string("a,b,,c", ",") == ["a", "b", "", "c"]
+    assert split_string("", ",") == []
+    h1 = hash_combine(0, 1)
+    h2 = hash_combine(h1, 2)
+    assert h1 != h2
+    assert 0 <= h2 < 2**64
+
+
+def test_thread_exception_propagates():
+    # reference OMPException (common.h:53-87): worker exception rethrown on caller
+    def bad():
+        raise ValueError("worker died")
+
+    with pytest.raises(ValueError, match="worker died"):
+        run_parallel([bad, lambda: None])
+
+
+def test_thread_exception_first_wins():
+    exc = ThreadException()
+    order = []
+
+    def fail(tag):
+        order.append(tag)
+        raise RuntimeError(tag)
+
+    t1 = threading.Thread(target=exc.wrap(fail), args=("a",))
+    t1.start()
+    t1.join()
+    t2 = threading.Thread(target=exc.wrap(fail), args=("b",))
+    t2.start()
+    t2.join()
+    with pytest.raises(RuntimeError, match="a"):
+        exc.rethrow()
