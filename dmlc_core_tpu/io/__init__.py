@@ -1,0 +1,28 @@
+"""URI-addressed streams, filesystems, RecordIO, and sharded input splits.
+
+Reference: include/dmlc/io.h, recordio.h, src/io/ (SURVEY §2.3).
+"""
+
+from .uri import URI, URISpec  # noqa: F401
+from .stream import (  # noqa: F401
+    Stream,
+    SeekStream,
+    MemoryStream,
+    FileStream,
+    Serializable,
+)
+from .filesystem import (  # noqa: F401
+    FileSystem,
+    FileInfo,
+    LocalFileSystem,
+    MemoryFileSystem,
+    TemporaryDirectory,
+    FS_REGISTRY,
+)
+from .recordio import (  # noqa: F401
+    KMAGIC,
+    RecordIOWriter,
+    RecordIOReader,
+    RecordIOChunkReader,
+)
+from . import serializer  # noqa: F401

@@ -1,0 +1,231 @@
+"""RecordIO: splittable binary record container, bit-compatible with the
+reference format so existing ``.rec`` data loads unchanged.
+
+Reference: include/dmlc/recordio.h:16-45 (format), src/recordio.cc (codec).
+
+Frame layout (little-endian uint32 words)::
+
+    [kMagic = 0xced7230a] [lrec] [data ...] [zero pad to 4-byte boundary]
+    lrec = (cflag << 29) | length        # length < 2**29 (512 MB)
+
+When the payload itself contains the magic word at a 4-byte-aligned offset,
+the writer splits the record at each such occurrence into a multi-part chain
+(the occurrence itself is elided and re-inserted by the reader):
+
+    cflag 0: complete record    1: start   2: middle   3: end
+
+TPU-first design departure: scanning for aligned magic words is the hot loop;
+we vectorize it with one numpy view + compare over the whole payload instead
+of a byte loop (reference scans per-word, src/recordio.cc:22-28). The native
+C++ core does the same with SIMD-friendly word scans.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import Error, check, check_lt
+from .stream import SeekStream, Stream
+
+__all__ = [
+    "KMAGIC",
+    "RecordIOWriter",
+    "RecordIOReader",
+    "RecordIOChunkReader",
+    "encode_lrec",
+    "decode_flag",
+    "decode_length",
+]
+
+KMAGIC = 0xCED7230A  # reference recordio.h:43; (kMagic >> 29) & 7 > 3
+_MAGIC_BYTES = struct.pack("<I", KMAGIC)
+_MAX_LEN = 1 << 29
+
+
+def encode_lrec(cflag: int, length: int) -> int:
+    """Reference recordio.h:52-54."""
+    return ((cflag & 7) << 29) | length
+
+
+def decode_flag(lrec: int) -> int:
+    """Reference recordio.h:60-62."""
+    return (lrec >> 29) & 7
+
+
+def decode_length(lrec: int) -> int:
+    """Reference recordio.h:68-70."""
+    return lrec & (_MAX_LEN - 1)
+
+
+def _aligned_magic_positions(payload: bytes) -> np.ndarray:
+    """4-byte-aligned offsets where the payload equals the magic word.
+
+    Vectorized equivalent of the writer's scan loop (reference
+    src/recordio.cc:20-28): view the lower-aligned prefix as uint32 and
+    compare against little-endian kMagic in one pass.
+    """
+    lower = len(payload) & ~3
+    if lower == 0:
+        return np.empty(0, dtype=np.int64)
+    words = np.frombuffer(payload, dtype="<u4", count=lower // 4)
+    return (np.nonzero(words == KMAGIC)[0] * 4).astype(np.int64)
+
+
+class RecordIOWriter:
+    """Reference RecordIOWriter (recordio.h:38-115, recordio.cc:11-51)."""
+
+    def __init__(self, stream: Stream) -> None:
+        self.stream = stream
+        self.except_counter = 0  # number of magic collisions escaped
+
+    def write_record(self, data: bytes) -> None:
+        check_lt(len(data), _MAX_LEN, "RecordIO only accepts records < 2^29 bytes")
+        out: List[bytes] = []
+        dptr = 0
+        for pos in _aligned_magic_positions(data):
+            pos = int(pos)
+            cflag = 1 if dptr == 0 else 2
+            out.append(_MAGIC_BYTES)
+            out.append(struct.pack("<I", encode_lrec(cflag, pos - dptr)))
+            out.append(data[dptr:pos])
+            dptr = pos + 4
+            self.except_counter += 1
+        cflag = 3 if dptr != 0 else 0
+        out.append(_MAGIC_BYTES)
+        out.append(struct.pack("<I", encode_lrec(cflag, len(data) - dptr)))
+        out.append(data[dptr:])
+        # pad the FINAL part's data to a 4-byte boundary with zeros
+        tail_len = len(data) - dptr
+        pad = (4 - (tail_len & 3)) & 3
+        if pad:
+            out.append(b"\x00" * pad)
+        self.stream.write(b"".join(out))
+
+    def tell(self) -> int:
+        check(isinstance(self.stream, SeekStream), "stream is not seekable")
+        return self.stream.tell()  # type: ignore[union-attr]
+
+
+class RecordIOReader:
+    """Reference RecordIOReader (recordio.h:118-158, recordio.cc:53-82)."""
+
+    def __init__(self, stream: Stream) -> None:
+        self.stream = stream
+        self._eof = False
+
+    def next_record(self) -> Optional[bytes]:
+        """Next logical record (multi-part chains reassembled with the elided
+        magic words re-inserted), or None at end of stream."""
+        if self._eof:
+            return None
+        parts: List[bytes] = []
+        while True:
+            head = self.stream.read(8)
+            if len(head) == 0 and not parts:
+                self._eof = True
+                return None
+            if len(head) != 8:
+                raise Error("Invalid RecordIO file: truncated header")
+            magic, lrec = struct.unpack("<II", head)
+            if magic != KMAGIC:
+                raise Error(f"Invalid RecordIO file: bad magic {magic:#x}")
+            cflag = decode_flag(lrec)
+            length = decode_length(lrec)
+            upper = (length + 3) & ~3
+            data = self.stream.read_exact(upper)
+            parts.append(data[:length])
+            if cflag in (0, 3):
+                break
+            parts.append(_MAGIC_BYTES)  # re-insert elided magic between parts
+        return b"".join(parts)
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+
+_SCAN_BLOCK_WORDS = 1 << 18  # 1 MB of uint32 words per scan block
+
+
+def _find_next_record_head(buf: memoryview, start: int) -> int:
+    """First aligned offset >= start that looks like a record START header
+    (magic followed by lrec with cflag 0 or 1), or len(buf) if none.
+
+    Vectorized FindNextRecordIOHead (reference src/recordio.cc:85-100) —
+    scans forward in 1MB blocks with early exit, so per-part cost matches
+    the reference's scan-to-first-head instead of a full-chunk pass.
+    """
+    n = len(buf) & ~3
+    start = (start + 3) & ~3
+    nwords = n // 4
+    w0 = start // 4
+    while w0 + 1 < nwords:
+        w1 = min(w0 + _SCAN_BLOCK_WORDS, nwords)
+        # include one word of overlap so a head at the block boundary is seen
+        words = np.frombuffer(buf[w0 * 4 : min(w1 * 4 + 4, n)], dtype="<u4")
+        is_magic = words[:-1] == KMAGIC
+        flags = (words[1:] >> 29) & 7
+        hits = np.nonzero(is_magic & (flags <= 1))[0]
+        if len(hits):
+            return (w0 + int(hits[0])) * 4
+        w0 = w1
+    return len(buf)
+
+
+class RecordIOChunkReader:
+    """Split one InputSplit chunk among threads and iterate its records as
+    zero-copy memoryviews.
+
+    Reference RecordIOChunkReader (recordio.h:160-196, recordio.cc:101-156):
+    divide the chunk into ``num_parts`` aligned byte ranges, then snap each
+    boundary forward to the next record head.
+    """
+
+    def __init__(self, chunk: bytes, part_index: int = 0, num_parts: int = 1) -> None:
+        view = memoryview(chunk)
+        size = len(view)
+        nstep = (size + num_parts - 1) // num_parts
+        nstep = (nstep + 3) & ~3
+        begin = min(size, nstep * part_index)
+        end = min(size, nstep * (part_index + 1))
+        self._view = view
+        self._pos = _find_next_record_head(view, begin)
+        self._end = _find_next_record_head(view, end) if end < size else size
+
+    def next_record(self) -> Optional[memoryview]:
+        """Reference recordio.cc:114-156: reassembles multi-part records; a
+        single-part record is returned as a zero-copy view."""
+        if self._pos >= self._end:
+            return None
+        view = self._view
+        parts: List[bytes] = []
+        while True:
+            head = view[self._pos : self._pos + 8]
+            if len(head) != 8:
+                raise Error("RecordIO chunk: truncated header")
+            magic, lrec = struct.unpack("<II", head)
+            check(magic == KMAGIC, "RecordIO chunk: bad magic")
+            cflag = decode_flag(lrec)
+            length = decode_length(lrec)
+            upper = (length + 3) & ~3
+            start = self._pos + 8
+            self._pos = start + upper
+            if cflag == 0:
+                return view[start : start + length]
+            parts.append(bytes(view[start : start + length]))
+            if cflag == 3:
+                return memoryview(b"".join(parts))
+            parts.append(_MAGIC_BYTES)
+
+    def __iter__(self) -> Iterator[memoryview]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec

@@ -1,0 +1,147 @@
+"""Stream / SeekStream abstraction and concrete byte streams.
+
+Reference: dmlc::Stream / dmlc::SeekStream (include/dmlc/io.h:30-129),
+MemoryFixedSizeStream / MemoryStringStream (include/dmlc/memory_io.h:21-105),
+local FileStream (src/io/local_filesys.cc:27-67).
+
+Design: Python already has a rich binary-file protocol; the Stream class is a
+thin uniform wrapper so URI-dispatched backends (local, memory, gs/s3/http)
+and the serializer all meet one interface. ``Stream.create(uri, mode)`` is
+the factory (reference Stream::Create, src/io.cc:132-138).
+"""
+
+from __future__ import annotations
+
+import io as _pyio
+import os
+from typing import Optional, Union
+
+from ..utils.logging import Error, check
+
+__all__ = ["Stream", "SeekStream", "MemoryStream", "FileStream", "Serializable"]
+
+
+class Stream:
+    """Sequential byte stream (reference io.h:30-106)."""
+
+    def read(self, n: int = -1) -> bytes:
+        raise NotImplementedError
+
+    def write(self, data: bytes) -> int:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    # -- framed helpers (Stream::Write<T>/Read<T> live in serializer.py) ----
+    def read_exact(self, n: int) -> bytes:
+        """Read exactly n bytes or raise (consumers needing the
+        read-or-EOF distinction use read())."""
+        buf = self.read(n)
+        if len(buf) != n:
+            raise Error(f"Stream: expected {n} bytes, got {len(buf)}")
+        return buf
+
+    def __enter__(self) -> "Stream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- factory ------------------------------------------------------------
+    @staticmethod
+    def create(uri: str, mode: str = "r", allow_null: bool = False) -> Optional["Stream"]:
+        """URI-dispatched stream factory (reference Stream::Create,
+        src/io.cc:132-138). mode: 'r'|'w'|'a' (binary always).
+
+        ``allow_null`` forgives only the open itself (missing file); an
+        unknown protocol or bad mode is always fatal, as in the reference
+        (src/io.cc:30-71 makes protocol dispatch unconditional).
+        """
+        from .filesystem import FileSystem  # local import: filesystem imports us
+
+        check(mode in ("r", "w", "a"), f"invalid stream mode {mode!r}")
+        fs = FileSystem.get_instance(uri)
+        try:
+            return fs.open(uri, mode)
+        except (OSError, Error):
+            if allow_null:
+                return None
+            raise
+
+
+class SeekStream(Stream):
+    """Stream with random access (reference io.h:109-129)."""
+
+    def seek(self, pos: int) -> None:
+        raise NotImplementedError
+
+    def tell(self) -> int:
+        raise NotImplementedError
+
+    @staticmethod
+    def create_for_read(uri: str, allow_null: bool = False) -> Optional["SeekStream"]:
+        """Reference SeekStream::CreateForRead (io.cc:140-145)."""
+        s = Stream.create(uri, "r", allow_null=allow_null)
+        if s is not None:
+            check(isinstance(s, SeekStream), f"{uri} does not support seeking")
+        return s  # type: ignore[return-value]
+
+
+class _FileLike(SeekStream):
+    """Adapter over any Python binary file object."""
+
+    def __init__(self, fp) -> None:
+        self._fp = fp
+
+    def read(self, n: int = -1) -> bytes:
+        return self._fp.read(n)
+
+    def write(self, data: Union[bytes, bytearray, memoryview]) -> int:
+        return self._fp.write(data)
+
+    def seek(self, pos: int) -> None:
+        self._fp.seek(pos)
+
+    def tell(self) -> int:
+        return self._fp.tell()
+
+    def flush(self) -> None:
+        self._fp.flush()
+
+    def close(self) -> None:
+        self._fp.close()
+
+
+class FileStream(_FileLike):
+    """Local-file stream (reference FileStream, src/io/local_filesys.cc:27-67)."""
+
+    def __init__(self, path: str, mode: str = "r") -> None:
+        check(mode in ("r", "w", "a"), f"invalid stream mode {mode!r}")
+        super().__init__(open(path, mode + "b"))
+        self.path = path
+
+
+class MemoryStream(_FileLike):
+    """In-memory seekable stream (reference MemoryStringStream,
+    include/dmlc/memory_io.h:66-105)."""
+
+    def __init__(self, data: bytes = b"") -> None:
+        super().__init__(_pyio.BytesIO(data))
+
+    def getvalue(self) -> bytes:
+        return self._fp.getvalue()
+
+
+class Serializable:
+    """Interface for objects serializable to/from a Stream
+    (reference io.h:132-146)."""
+
+    def save(self, stream: Stream) -> None:
+        raise NotImplementedError
+
+    def load(self, stream: Stream) -> None:
+        raise NotImplementedError

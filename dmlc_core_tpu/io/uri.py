@@ -1,0 +1,84 @@
+"""URI parsing and the dataset-option URI sugar.
+
+Reference: io::URI (include/dmlc/io.h:525-559) and io::URISpec
+(src/io/uri_spec.h:21-75). A dataset URI can carry per-dataset options and a
+cache-file hint::
+
+    gs://bucket/path/train.libsvm?format=libsvm&nthread=4#cachefile
+
+The cache file gets a ``.splitN.partK`` suffix per shard
+(reference uri_spec.h:42-75).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+__all__ = ["URI", "URISpec"]
+
+
+class URI:
+    """protocol/host/path decomposition (reference io.h:525-559).
+
+    ``file:///a/b`` → protocol='file://', host='', path='/a/b'
+    ``/a/b``        → protocol='', host='', path='/a/b'
+    ``gs://b/k``    → protocol='gs://', host='b', path='/k'
+    """
+
+    __slots__ = ("protocol", "host", "path")
+
+    def __init__(self, uri: str) -> None:
+        pos = uri.find("://")
+        if pos < 0:
+            self.protocol = ""
+            rest = uri
+        else:
+            self.protocol = uri[: pos + 3]
+            rest = uri[pos + 3 :]
+        if self.protocol in ("", "file://"):
+            # local paths keep everything as path (reference treats
+            # file://host/path host as part of nothing useful)
+            self.host = ""
+            self.path = rest
+        else:
+            slash = rest.find("/")
+            if slash < 0:
+                self.host, self.path = rest, ""
+            else:
+                self.host, self.path = rest[:slash], rest[slash:]
+
+    @property
+    def name(self) -> str:
+        """Canonical string form (reference URI::name)."""
+        return f"{self.protocol}{self.host}{self.path}"
+
+    def __repr__(self) -> str:
+        return f"URI({self.name!r})"
+
+
+class URISpec:
+    """URI + ``?k=v&k2=v2`` args + ``#cachefile`` hint (reference
+    src/io/uri_spec.h:21-75)."""
+
+    __slots__ = ("uri", "args", "cache_file")
+
+    def __init__(self, uri: str, part_index: int = 0, num_parts: int = 1) -> None:
+        self.args: Dict[str, str] = {}
+        self.cache_file = ""
+        base = uri
+        if "#" in base:
+            base, _, cache = base.partition("#")
+            if num_parts != 1:
+                cache = f"{cache}.split{num_parts}.part{part_index}"
+            self.cache_file = cache
+        if "?" in base:
+            base, _, query = base.partition("?")
+            for kv in query.split("&"):
+                if not kv:
+                    continue
+                k, _, v = kv.partition("=")
+                self.args[k] = v
+        self.uri = base
+
+    def __repr__(self) -> str:
+        return f"URISpec(uri={self.uri!r}, args={self.args}, cache={self.cache_file!r})"
