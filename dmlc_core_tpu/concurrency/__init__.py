@@ -1,0 +1,10 @@
+"""Prefetch pipelines and thread lifecycle management (reference:
+include/dmlc/threadediter.h, concurrency.h, thread_group.h)."""
+
+from .threaded_iter import ThreadedIter  # noqa: F401
+from .thread_group import (  # noqa: F401
+    ConcurrentBlockingQueue,
+    ManualEvent,
+    ThreadGroup,
+    TimerThread,
+)

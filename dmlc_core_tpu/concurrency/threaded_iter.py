@@ -1,0 +1,140 @@
+"""ThreadedIter: bounded producer-consumer prefetch.
+
+Reference: include/dmlc/threadediter.h. The backbone of every pipeline stage:
+read-ahead (threaded_input_split.h:33-42), parse-ahead (parser.h:71-126) and
+cache replay (disk_row_iter.h:100-108) all wrap a producer in one of these.
+
+TPU-native rethink: the reference's cell-recycling protocol
+(threadediter.h:443-488) exists to avoid malloc/free churn of C++ buffers;
+in Python, buffers are numpy arrays owned by the GC and the double-buffer
+staging layer recycles device buffers instead (staging/pipeline.py). What we
+keep is the contract that matters for correctness:
+
+- bounded queue (default capacity 2 = double buffering,
+  threaded_input_split.h:33)
+- producer-thread exceptions are captured and re-raised on the consumer
+  thread, including during before_first (threadediter.h:406-435,490-505 and
+  test unittest_threaditer_exc_handling.cc)
+- restartable: before_first() tears down the producer and restarts it
+  (threadediter.h:330-440 Init/BeforeFirst signals)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Generic, Iterable, Iterator, Optional, TypeVar
+
+T = TypeVar("T")
+
+_ITEM, _END, _EXC = 0, 1, 2
+
+__all__ = ["ThreadedIter"]
+
+
+class ThreadedIter(Generic[T]):
+    """Prefetch items from ``producer_fn()`` on a background thread.
+
+    ``producer_fn`` must return a fresh iterator each call (each epoch).
+    """
+
+    def __init__(
+        self,
+        producer_fn: Callable[[], Iterable[T]],
+        max_capacity: int = 2,
+        name: str = "threadediter",
+    ) -> None:
+        self._producer_fn = producer_fn
+        self._cap = max_capacity
+        self._name = name
+        self._queue: "queue.Queue" = queue.Queue()
+        self._kill = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._exhausted = False
+        self._destroyed = False
+        self._start()
+
+    # -- producer side -------------------------------------------------------
+    def _start(self) -> None:
+        self._queue = queue.Queue(maxsize=self._cap)
+        self._kill = threading.Event()
+        self._exhausted = False
+        t = threading.Thread(
+            target=self._run,
+            args=(self._queue, self._kill),
+            daemon=True,
+            name=self._name,
+        )
+        self._thread = t
+        t.start()
+
+    def _put(self, q: "queue.Queue", kill: threading.Event, item) -> bool:
+        while not kill.is_set():
+            try:
+                q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self, q: "queue.Queue", kill: threading.Event) -> None:
+        try:
+            for item in self._producer_fn():
+                if not self._put(q, kill, (_ITEM, item)):
+                    return
+                if kill.is_set():
+                    return
+            self._put(q, kill, (_END, None))
+        except BaseException as e:  # noqa: BLE001 — crosses thread boundary
+            self._put(q, kill, (_EXC, e))
+
+    def _stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._kill.set()
+        while t.is_alive():
+            try:  # drain so a blocked put() notices the kill flag
+                self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
+        self._thread = None
+
+    # -- consumer side -------------------------------------------------------
+    def next(self) -> Optional[T]:
+        """Next item or None at end of stream; re-raises producer errors
+        (reference ThrowExceptionIfSet, threadediter.h:490-505)."""
+        if self._exhausted or self._destroyed:
+            return None
+        tag, val = self._queue.get()
+        if tag == _ITEM:
+            return val
+        self._exhausted = True
+        if tag == _EXC:
+            raise val
+        return None
+
+    def __iter__(self) -> Iterator[T]:
+        while True:
+            item = self.next()
+            if item is None and self._exhausted:
+                return
+            yield item  # type: ignore[misc]
+
+    def before_first(self) -> None:
+        """Restart the producer from the beginning (reference
+        threadediter.h kBeforeFirst signal)."""
+        self._stop()
+        self._start()
+
+    def destroy(self) -> None:
+        """Tear down the producer thread (reference ~ThreadedIter)."""
+        self._destroyed = True
+        self._stop()
+
+    def __del__(self) -> None:
+        try:
+            self.destroy()
+        except Exception:
+            pass

@@ -26,3 +26,15 @@ from .recordio import (  # noqa: F401
     RecordIOChunkReader,
 )
 from . import serializer  # noqa: F401
+from .split import (  # noqa: F401
+    InputSplit,
+    InputSplitBase,
+    LineSplitter,
+    RecordIOSplitter,
+    IndexedRecordIOSplitter,
+    SingleFileSplit,
+    ThreadedInputSplit,
+    CachedInputSplit,
+    InputSplitShuffle,
+)
+from .split import create as create_input_split  # noqa: F401

@@ -153,6 +153,29 @@ class RecordIOReader:
 _SCAN_BLOCK_WORDS = 1 << 18  # 1 MB of uint32 words per scan block
 
 
+def first_head_in_words(words: np.ndarray) -> int:
+    """Word index of the first record-START header (magic word followed by an
+    lrec with cflag 0 or 1) in a little-endian uint32 view, or -1.
+
+    The single vectorized implementation of the head predicate used by the
+    chunk reader, the RecordIO splitter, and the native-core fallback
+    (reference FindNextRecordIOHead, src/recordio.cc:85-100).
+    """
+    if len(words) < 2:
+        return -1
+    hits = np.nonzero((words[:-1] == KMAGIC) & (((words[1:] >> 29) & 7) <= 1))[0]
+    return int(hits[0]) if len(hits) else -1
+
+
+def last_head_in_words(words: np.ndarray) -> int:
+    """Word index of the last record-START header, or -1 (reference
+    backward scan, src/io/recordio_split.cc:26-42)."""
+    if len(words) < 2:
+        return -1
+    hits = np.nonzero((words[:-1] == KMAGIC) & (((words[1:] >> 29) & 7) <= 1))[0]
+    return int(hits[-1]) if len(hits) else -1
+
+
 def _find_next_record_head(buf: memoryview, start: int) -> int:
     """First aligned offset >= start that looks like a record START header
     (magic followed by lrec with cflag 0 or 1), or len(buf) if none.
@@ -169,11 +192,9 @@ def _find_next_record_head(buf: memoryview, start: int) -> int:
         w1 = min(w0 + _SCAN_BLOCK_WORDS, nwords)
         # include one word of overlap so a head at the block boundary is seen
         words = np.frombuffer(buf[w0 * 4 : min(w1 * 4 + 4, n)], dtype="<u4")
-        is_magic = words[:-1] == KMAGIC
-        flags = (words[1:] >> 29) & 7
-        hits = np.nonzero(is_magic & (flags <= 1))[0]
-        if len(hits):
-            return (w0 + int(hits[0])) * 4
+        hit = first_head_in_words(words)
+        if hit >= 0:
+            return (w0 + hit) * 4
         w0 = w1
     return len(buf)
 

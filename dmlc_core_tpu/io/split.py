@@ -1,0 +1,971 @@
+"""Record-aligned sharded input splits — data parallelism over byte ranges.
+
+Reference: include/dmlc/io.h:155-302 (InputSplit interface),
+src/io/input_split_base.{h,cc} (partition math), line_split.cc,
+recordio_split.cc, indexed_recordio_split.cc, single_file_split.h,
+threaded_input_split.h, cached_input_split.h, input_split_shuffle.h.
+
+Every worker reads a disjoint, record-aligned slice of a URI set:
+``create(uri, part_index, num_parts, type)``. This is the reference's only
+model-training parallelism (SURVEY §2.9) and the axis the TPU staging layer
+sources from the process mesh (``parallel/``): rank ↔ jax.process_index().
+
+Semantics ported exactly (this is where the bugs live — SURVEY §7 hard part
+3); the *implementation* is Pythonic: chunks are bytes, records are bytes
+views, hot scans are vectorized numpy, and the native C++ core replaces the
+inner loops when present.
+"""
+
+from __future__ import annotations
+
+import bisect
+import random
+import re
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..concurrency.threaded_iter import ThreadedIter
+from ..utils.logging import Error, check, check_eq
+from . import serializer
+from .filesystem import FileInfo, FileSystem
+from .recordio import (
+    RecordIOChunkReader,
+    first_head_in_words,
+    last_head_in_words,
+)
+from .stream import SeekStream, Stream
+from .uri import URISpec
+
+__all__ = [
+    "InputSplit",
+    "InputSplitBase",
+    "LineSplitter",
+    "RecordIOSplitter",
+    "IndexedRecordIOSplitter",
+    "SingleFileSplit",
+    "ThreadedInputSplit",
+    "CachedInputSplit",
+    "InputSplitShuffle",
+    "create",
+]
+
+# 8 MB chunk buffer (reference kBufferSize = 2<<20 uint32 words,
+# src/io/input_split_base.h:39-40)
+DEFAULT_BUFFER_BYTES = (2 << 20) * 4
+
+
+class InputSplit:
+    """Public interface (reference io.h:155-302)."""
+
+    def next_record(self) -> Optional[bytes]:
+        """Next record or None at end of split. For text: one line (no
+        trailing newline). For recordio: one record payload, header stripped."""
+        raise NotImplementedError
+
+    def next_chunk(self) -> Optional[bytes]:
+        """A chunk of whole records (parse fan-out unit), or None."""
+        raise NotImplementedError
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        """Chunk with a record-count hint (reference io.h:230-247)."""
+        return self.next_chunk()
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise NotImplementedError
+
+    def total_size(self) -> int:
+        raise NotImplementedError
+
+    def hint_chunk_size(self, nbytes: int) -> None:
+        pass
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        """Split a chunk produced by next_chunk back into records."""
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[bytes]:
+        while True:
+            rec = self.next_record()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self) -> None:
+        pass
+
+
+def _expand_uris(filesys: FileSystem, uri: str) -> List[str]:
+    """';'-separated URI list with regex glob expansion (reference
+    ConvertToURIs, input_split_base.cc:96-147, DMLC_USE_REGEX)."""
+    out: List[str] = []
+    for part in uri.split(";"):
+        if not part:
+            continue
+        name = part
+        pos = name.rfind("/")
+        if pos < 0 or pos + 1 == len(name):
+            out.append(name)
+            continue
+        parent = name[:pos]
+        try:
+            listing = filesys.list_directory(parent)
+        except (OSError, Error):
+            out.append(name)  # parent unlistable: let GetPathInfo report
+            continue
+        stripped = name.rstrip("/")
+        exact = [f for f in listing if f.path.rstrip("/") == stripped]
+        if exact:
+            out.append(exact[0].path)
+            continue
+        try:
+            pattern = re.compile(stripped)
+        except re.error as e:
+            raise Error(f"bad regex {stripped!r} in input URI: {e}") from e
+        matched = False
+        for f in listing:
+            if f.type != "file" or f.size == 0:
+                continue
+            if pattern.fullmatch(f.path.rstrip("/")):
+                out.append(f.path)
+                matched = True
+        if not matched and not exact:
+            out.append(name)  # fall through to the missing-file error
+    return out
+
+
+class InputSplitBase(InputSplit):
+    """Byte-range sharding core (reference src/io/input_split_base.{h,cc}).
+
+    Subclasses define the record format via ``_align``, ``_is_text``,
+    ``seek_record_begin``, ``find_last_record_begin``, ``extract_records``.
+    """
+
+    _align = 1
+    _is_text = False
+
+    def __init__(
+        self,
+        uri: str,
+        part_index: int = 0,
+        num_parts: int = 1,
+        filesys: Optional[FileSystem] = None,
+        recurse_directories: bool = False,
+    ) -> None:
+        self.filesys = filesys or FileSystem.get_instance(uri.split(";")[0])
+        self._init_files(uri, recurse_directories)
+        self.buffer_size = DEFAULT_BUFFER_BYTES
+        self._fs: Optional[Stream] = None
+        self._file_ptr = 0
+        self.offset_begin = 0
+        self.offset_end = 0
+        self.offset_curr = 0
+        self._overflow = b""
+        self._rec_iter: Optional[Iterator[bytes]] = None
+        self.reset_partition(part_index, num_parts)
+
+    # -- file table ----------------------------------------------------------
+    def _init_files(self, uri: str, recurse: bool) -> None:
+        """Reference InitInputFileInfo (input_split_base.cc:149-175):
+        expand URIs, descend directories, keep non-empty files."""
+        files: List[FileInfo] = []
+        for path in _expand_uris(self.filesys, uri):
+            try:
+                info = self.filesys.get_path_info(path)
+            except (OSError, Error):
+                continue  # missing candidates fall to the aggregate error
+            if info.type == "directory":
+                listing = (
+                    self.filesys.list_directory_recursive(info.path)
+                    if recurse
+                    else self.filesys.list_directory(info.path)
+                )
+                files.extend(
+                    f for f in listing if f.type == "file" and f.size != 0
+                )
+            elif info.size != 0:
+                files.append(info)
+        if not files:
+            raise Error(f"Cannot find any files that match the URI pattern {uri!r}")
+        self.files = files
+        offsets = [0]
+        for f in files:
+            if f.size % self._align != 0:
+                raise Error(f"file {f.path} does not align by {self._align} bytes")
+            offsets.append(offsets[-1] + f.size)
+        self.file_offset = offsets
+
+    def total_size(self) -> int:
+        return self.file_offset[-1]
+
+    def hint_chunk_size(self, nbytes: int) -> None:
+        self.buffer_size = max(nbytes, 1024)
+
+    # -- format hooks --------------------------------------------------------
+    def seek_record_begin(self, stream: Stream) -> int:
+        """Bytes to skip from the stream's position to the next record
+        start."""
+        raise NotImplementedError
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Offset of the last record start within data (0 if none)."""
+        raise NotImplementedError
+
+    # -- partition math ------------------------------------------------------
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Byte-range computation + record alignment (reference
+        ResetPartition, input_split_base.cc:30-64)."""
+        ntotal = self.file_offset[-1]
+        nstep = (ntotal + num_parts - 1) // num_parts
+        nstep = ((nstep + self._align - 1) // self._align) * self._align
+        self.offset_begin = min(nstep * part_index, ntotal)
+        self.offset_end = min(nstep * (part_index + 1), ntotal)
+        self.offset_curr = self.offset_begin
+        self._overflow = b""
+        self._rec_iter = None
+        if self.offset_begin == self.offset_end:
+            self._close_fs()
+            return
+        file_ptr = bisect.bisect_right(self.file_offset, self.offset_begin) - 1
+        file_ptr_end = bisect.bisect_right(self.file_offset, self.offset_end) - 1
+        # snap the END forward to the next record boundary, unless it already
+        # sits on a file boundary (file starts are record starts)
+        if self.offset_end != self.file_offset[file_ptr_end]:
+            with self._open(file_ptr_end) as fs:
+                fs.seek(self.offset_end - self.file_offset[file_ptr_end])
+                self.offset_end += self.seek_record_begin(fs)
+        # snap the BEGIN forward the same way
+        if self.offset_begin != self.file_offset[file_ptr]:
+            with self._open(file_ptr) as fs:
+                fs.seek(self.offset_begin - self.file_offset[file_ptr])
+                self.offset_begin += self.seek_record_begin(fs)
+        self.offset_curr = self.offset_begin
+        self.before_first()
+
+    def _open(self, file_ptr: int) -> SeekStream:
+        s = self.filesys.open(self.files[file_ptr].path, "r")
+        check(isinstance(s, SeekStream), "input files must be seekable")
+        return s  # type: ignore[return-value]
+
+    def _close_fs(self) -> None:
+        if self._fs is not None:
+            self._fs.close()
+            self._fs = None
+
+    def before_first(self) -> None:
+        """Seek back to the partition start (reference
+        input_split_base.cc:66-82)."""
+        if self.offset_begin >= self.offset_end:
+            return
+        fp = bisect.bisect_right(self.file_offset, self.offset_begin) - 1
+        self._close_fs()
+        self._file_ptr = fp
+        self._fs = self._open(fp)
+        self._fs.seek(self.offset_begin - self.file_offset[fp])
+        self.offset_curr = self.offset_begin
+        self._overflow = b""
+        self._rec_iter = None
+
+    # -- reading -------------------------------------------------------------
+    def _read(self, size: int) -> bytes:
+        """Multi-file read with NOEOL newline injection at text file joins
+        (reference Read, input_split_base.cc:177-219 and PR#385)."""
+        # snapping can push offset_begin past offset_end (degenerate tail
+        # partition) — reference Read guards this (input_split_base.cc:183)
+        if (
+            self._fs is None
+            or self.offset_begin >= self.offset_end
+            or self.offset_curr >= self.offset_end
+        ):
+            return b""
+        size = min(size, self.offset_end - self.offset_curr)
+        if size == 0:
+            return b""
+        out: List[bytes] = []
+        nleft = size
+        while nleft > 0:
+            data = self._fs.read(nleft)
+            if data:
+                out.append(data)
+                nleft -= len(data)
+                self.offset_curr += len(data)
+                continue
+            # current file exhausted
+            if self._is_text:
+                out.append(b"\n")  # join NOEOL text files safely
+                nleft -= 1
+            check_eq(
+                self.offset_curr,
+                self.file_offset[self._file_ptr + 1],
+                "file offset not calculated correctly",
+            )
+            if self._file_ptr + 1 >= len(self.files):
+                break
+            self._file_ptr += 1
+            self._fs.close()
+            self._fs = self._open(self._file_ptr)
+        return b"".join(out)
+
+    def _read_chunk(self, max_size: int) -> Optional[bytes]:
+        """One buffer of COMPLETE records; keeps the partial-record tail as
+        overflow (reference ReadChunk, input_split_base.cc:221-258).
+
+        Returns None at end of split, b'' when the buffer is too small for
+        one record (caller doubles), else the record bytes.
+        """
+        olen = len(self._overflow)
+        if max_size <= olen:
+            return b""
+        data = self._overflow + self._read(max_size - olen)
+        if len(data) == 0:
+            return None
+        self._overflow = b""
+        if self._is_text:
+            if len(data) == olen:
+                # no new bytes: the final record has no trailing newline
+                # (reference PR#452 NOEOL-at-EOF fix)
+                data += b"\n"
+        elif len(data) != max_size:
+            # non-text last buffer: partition end is a record boundary
+            return data
+        cut = self.find_last_record_begin(data)
+        self._overflow = data[cut:]
+        return data[:cut]
+
+    def _next_chunk_ex(self) -> Optional[bytes]:
+        """Grow-on-zero buffer loop (reference Chunk::Load,
+        input_split_base.cc:260-277)."""
+        size = self.buffer_size
+        while True:
+            chunk = self._read_chunk(size)
+            if chunk is None:
+                return None
+            if len(chunk) == 0:
+                size *= 2
+                continue
+            return chunk
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._next_chunk_ex()
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            if self._rec_iter is not None:
+                rec = next(self._rec_iter, None)
+                if rec is not None:
+                    return rec
+            chunk = self._next_chunk_ex()
+            if chunk is None:
+                return None
+            self._rec_iter = self.extract_records(chunk)
+
+    def close(self) -> None:
+        self._close_fs()
+
+
+class LineSplitter(InputSplitBase):
+    """record = text line (reference src/io/line_split.{h,cc}); align=1."""
+
+    _align = 1
+    _is_text = True
+
+    def seek_record_begin(self, stream: Stream) -> int:
+        """Skip to just after the next newline run (reference
+        line_split.cc:9-26); buffered instead of byte-at-a-time."""
+        nstep = 0
+        seen_newline = False
+        while True:
+            buf = stream.read(65536)
+            if not buf:
+                return nstep
+            i = 0
+            if not seen_newline:
+                j = _find_newline(buf)
+                if j < 0:
+                    nstep += len(buf)
+                    continue
+                nstep += j + 1
+                seen_newline = True
+                i = j + 1
+            while i < len(buf) and buf[i] in (0x0A, 0x0D):
+                nstep += 1
+                i += 1
+            if i < len(buf):
+                return nstep
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Reference line_split.cc:27-34."""
+        cut = max(data.rfind(b"\n"), data.rfind(b"\r"))
+        return cut + 1 if cut > 0 else 0
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        """Non-empty lines; consecutive newlines collapse (reference
+        ExtractNextRecord, line_split.cc:36-55 absorbs newline runs)."""
+        for line in chunk.replace(b"\r", b"\n").split(b"\n"):
+            if line:
+                yield line
+
+
+def _find_newline(buf: bytes) -> int:
+    a, b = buf.find(b"\n"), buf.find(b"\r")
+    if a < 0:
+        return b
+    if b < 0:
+        return a
+    return min(a, b)
+
+
+class RecordIOSplitter(InputSplitBase):
+    """record = RecordIO frame (reference src/io/recordio_split.{h,cc});
+    align=4."""
+
+    _align = 4
+    _is_text = False
+
+    def seek_record_begin(self, stream: Stream) -> int:
+        """Scan forward for a record head (reference recordio_split.cc:9-25),
+        buffered with one-word overlap across blocks."""
+        pos = 0  # absolute offset of buf[0] from the scan start
+        buf = b""
+        while True:
+            data = stream.read(1 << 16)
+            buf += data
+            usable = len(buf) & ~3
+            if usable >= 8:
+                words = np.frombuffer(buf[:usable], dtype="<u4")
+                hit = first_head_in_words(words)
+                if hit >= 0:
+                    return pos + hit * 4
+            if not data:
+                return pos + len(buf)  # EOF: skip everything (reference :12)
+            # keep the last word: it may be the magic of a header whose lrec
+            # arrives in the next block
+            keep = max(usable - 4, 0)
+            pos += keep
+            buf = buf[keep:]
+
+    def find_last_record_begin(self, data: bytes) -> int:
+        """Reference recordio_split.cc:26-42 (backward scan → we take the
+        last forward hit; same record head)."""
+        usable = len(data) & ~3
+        hit = last_head_in_words(np.frombuffer(data[:usable], dtype="<u4"))
+        return hit * 4 if hit >= 0 else 0
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        for rec in RecordIOChunkReader(chunk, 0, 1):
+            yield bytes(rec)
+
+
+class IndexedRecordIOSplitter(RecordIOSplitter):
+    """Shards by RECORD COUNT via an external index file, with optional
+    per-epoch shuffled batched reads (reference
+    src/io/indexed_recordio_split.{h,cc}).
+
+    Index file: whitespace-separated ``index offset`` pairs
+    (ReadIndexFile, indexed_recordio_split.cc:43-62).
+    """
+
+    KRAND_MAGIC = 111  # reference indexed_recordio_split.h:82
+
+    def __init__(
+        self,
+        uri: str,
+        index_uri: str,
+        part_index: int = 0,
+        num_parts: int = 1,
+        batch_size: int = 256,
+        shuffle: bool = False,
+        seed: int = 0,
+        filesys: Optional[FileSystem] = None,
+    ) -> None:
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+        self._rnd = random.Random(self.KRAND_MAGIC + seed)
+        self._index: List[Tuple[int, int]] = []  # (offset, size)
+        self._index_uri = index_uri
+        self.index_begin = 0
+        self.index_end = 0
+        self._current = 0
+        self._n_overflow = 0
+        self._permutation: List[int] = []
+        super().__init__(uri, part_index, num_parts, filesys=filesys)
+
+    def _read_index_file(self) -> None:
+        stream = Stream.create(self._index_uri, "r")
+        with stream:
+            text = stream.read().decode()
+        offsets = sorted(int(tok) for i, tok in enumerate(text.split()) if i % 2 == 1)
+        if not offsets:
+            raise Error(f"empty index file {self._index_uri!r}")
+        total = self.file_offset[-1]
+        self._index = [
+            (offsets[i], (offsets[i + 1] if i + 1 < len(offsets) else total) - offsets[i])
+            for i in range(len(offsets))
+        ]
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        """Record-count range (reference indexed_recordio_split.cc:12-41)."""
+        if not self._index:
+            self._read_index_file()
+        ntotal = len(self._index)
+        nstep = (ntotal + num_parts - 1) // num_parts
+        if part_index * nstep >= ntotal:
+            self.offset_begin = self.offset_end = self.offset_curr = 0
+            self.index_begin = self.index_end = 0
+            self._permutation = []
+            self._current = 0
+            self._n_overflow = 0
+            self._overflow = b""
+            self._rec_iter = None
+            self._close_fs()
+            return
+        self.index_begin = part_index * nstep
+        self.offset_begin = self._index[self.index_begin][0]
+        self.index_end = min((part_index + 1) * nstep, ntotal)
+        if self.index_end < ntotal:
+            self.offset_end = self._index[self.index_end][0]
+        else:
+            self.offset_end = self.file_offset[-1]
+        self._n_overflow = 0
+        self.before_first()
+
+    def before_first(self) -> None:
+        """Reshuffles the permutation each epoch with persistent RNG state
+        (reference indexed_recordio_split.cc:221-233)."""
+        if self.index_end <= self.index_begin:
+            return
+        if self.shuffle:
+            self._permutation = list(range(self.index_begin, self.index_end))
+            self._rnd.shuffle(self._permutation)
+            self._current = 0
+        else:
+            self._current = self.index_begin
+        self._n_overflow = 0
+        super().before_first()
+
+    def _read_at(self, offset: int, size: int) -> bytes:
+        """Seek to an absolute dataset offset and read (the shuffle path's
+        per-record random I/O, reference indexed_recordio_split.cc:163-191)."""
+        fp = bisect.bisect_right(self.file_offset, offset) - 1
+        if fp != self._file_ptr or self._fs is None:
+            self._close_fs()
+            self._file_ptr = fp
+            self._fs = self._open(fp)
+        self._fs.seek(offset - self.file_offset[fp])
+        self.offset_curr = offset
+        out: List[bytes] = []
+        nleft = size
+        while nleft > 0:
+            data = self._fs.read(nleft)
+            if not data:
+                if self._file_ptr + 1 >= len(self.files):
+                    break
+                self._file_ptr += 1
+                self._fs.close()
+                self._fs = self._open(self._file_ptr)
+                continue
+            out.append(data)
+            nleft -= len(data)
+            self.offset_curr += len(data)
+        return b"".join(out)
+
+    def next_batch_ex(self, n_records: int) -> Optional[bytes]:
+        """Reference NextBatchEx (indexed_recordio_split.cc:159-212):
+        shuffled = per-record seeks; sequential = one coalesced span."""
+        if self.shuffle:
+            n = self._n_overflow or n_records
+            parts: List[bytes] = []
+            while len(parts) < n and self._current < len(self._permutation):
+                off, size = self._index[self._permutation[self._current]]
+                parts.append(self._read_at(off, size))
+                self._current += 1
+            if not parts:
+                return None
+            self._n_overflow = n - len(parts)
+            return b"".join(parts)
+        n = self._n_overflow or n_records
+        last = min(self._current + n, self.index_end)
+        self._n_overflow = self._current + n - last
+        if last <= self._current:
+            return None
+        begin_off = self._index[self._current][0]
+        end_off = (
+            self._index[last][0] if last < len(self._index) else self.file_offset[-1]
+        )
+        chunk = self._read_at(begin_off, end_off - begin_off)
+        self._current = last
+        return chunk if chunk else None
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self.next_batch_ex(self.batch_size)
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        return self.next_batch_ex(n_records)
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            if self._rec_iter is not None:
+                rec = next(self._rec_iter, None)
+                if rec is not None:
+                    return rec
+            chunk = self.next_batch_ex(self.batch_size)
+            if chunk is None:
+                return None
+            self._rec_iter = self.extract_records(chunk)
+
+
+class SingleFileSplit(InputSplit):
+    """stdin / single-file text split without sharding (reference
+    src/io/single_file_split.h)."""
+
+    def __init__(self, path: str = "-") -> None:
+        self._path = path
+        self._stream = None
+        self._buffer = b""
+        self._eof = False
+        self._rec_iter: Optional[Iterator[bytes]] = None
+        self._size = 0
+        self.before_first()
+
+    def _open(self):
+        if self._path == "-":
+            import sys
+
+            return sys.stdin.buffer
+        return open(self._path, "rb")
+
+    def before_first(self) -> None:
+        if self._path == "-" and self._stream is not None:
+            raise Error("cannot rewind stdin")
+        if self._stream is not None and self._path != "-":
+            self._stream.close()
+        self._stream = self._open()
+        self._eof = False
+        self._rec_iter = None
+        self._overflow = b""
+
+    def total_size(self) -> int:
+        if self._path == "-":
+            return 0
+        import os
+
+        return os.path.getsize(self._path)
+
+    def next_chunk(self) -> Optional[bytes]:
+        while not self._eof:
+            data = self._stream.read(DEFAULT_BUFFER_BYTES)
+            if not data:
+                self._eof = True
+                if self._overflow:
+                    out, self._overflow = self._overflow + b"\n", b""
+                    return out
+                return None
+            data = self._overflow + data
+            cut = max(data.rfind(b"\n"), data.rfind(b"\r"))
+            if cut <= 0:
+                self._overflow = data
+                continue
+            self._overflow = data[cut + 1 :]
+            return data[: cut + 1]
+        return None
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        for line in chunk.replace(b"\r", b"\n").split(b"\n"):
+            if line:
+                yield line
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            if self._rec_iter is not None:
+                rec = next(self._rec_iter, None)
+                if rec is not None:
+                    return rec
+            chunk = self.next_chunk()
+            if chunk is None:
+                return None
+            self._rec_iter = self.extract_records(chunk)
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        check_eq(num_parts, 1, "SingleFileSplit does not shard")
+
+
+class ThreadedInputSplit(InputSplit):
+    """Read-ahead wrapper: prefetch chunks on a background thread with
+    double buffering (reference src/io/threaded_input_split.h,
+    set_max_capacity(2) at :33)."""
+
+    def __init__(self, base: InputSplitBase, max_capacity: int = 2) -> None:
+        self._base = base
+        self._cap = max_capacity
+        self._rec_iter: Optional[Iterator[bytes]] = None
+        self._first_epoch = True
+        self._iter: ThreadedIter[bytes] = ThreadedIter(
+            self._produce, max_capacity=max_capacity, name="split-prefetch"
+        )
+
+    def _produce(self):
+        if not self._first_epoch:
+            self._base.before_first()
+        self._first_epoch = False
+        while True:
+            chunk = self._base.next_chunk()
+            if chunk is None:
+                return
+            yield chunk
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._iter.next()
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            if self._rec_iter is not None:
+                rec = next(self._rec_iter, None)
+                if rec is not None:
+                    return rec
+            chunk = self._iter.next()
+            if chunk is None:
+                return None
+            self._rec_iter = self._base.extract_records(chunk)
+
+    def before_first(self) -> None:
+        self._rec_iter = None
+        self._iter.before_first()
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._iter.destroy()
+        self._base.reset_partition(part_index, num_parts)
+        self._first_epoch = True
+        self._rec_iter = None
+        self._iter = ThreadedIter(
+            self._produce, max_capacity=self._cap, name="split-prefetch"
+        )
+
+    def total_size(self) -> int:
+        return self._base.total_size()
+
+    def hint_chunk_size(self, nbytes: int) -> None:
+        self._base.hint_chunk_size(nbytes)
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        return self._base.extract_records(chunk)
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self._base.close()
+
+
+class CachedInputSplit(InputSplit):
+    """First epoch streams chunks to a local cache file while serving them;
+    later epochs replay the cache (reference src/io/cached_input_split.h:
+    InitPreprocIter :148-164, InitCachedIter :166-189)."""
+
+    def __init__(self, base: InputSplit, cache_file: str) -> None:
+        self._base = base
+        self._cache_file = cache_file
+        self._cache_complete = False
+        self._rec_iter: Optional[Iterator[bytes]] = None
+        self._iter: ThreadedIter[bytes] = ThreadedIter(
+            self._produce_preproc, name="split-cache-build"
+        )
+
+    def _produce_preproc(self):
+        out = Stream.create(self._cache_file, "w")
+        try:
+            while True:
+                chunk = self._base.next_chunk()
+                if chunk is None:
+                    break
+                serializer.write_bytes(out, chunk)
+                yield chunk
+            self._cache_complete = True
+        finally:
+            out.close()
+
+    def _produce_cached(self):
+        stream = Stream.create(self._cache_file, "r")
+        try:
+            while True:
+                n = serializer.try_read_scalar(stream, "uint64")
+                if n is None:
+                    return
+                yield stream.read_exact(n)
+        finally:
+            stream.close()
+
+    def before_first(self) -> None:
+        self._rec_iter = None
+        if self._cache_complete:
+            self._iter.destroy()
+            self._iter = ThreadedIter(self._produce_cached, name="split-cache-replay")
+        else:
+            # first pass didn't finish: rebuild the cache from scratch
+            self._iter.destroy()
+            self._base.before_first()
+            self._iter = ThreadedIter(self._produce_preproc, name="split-cache-build")
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._iter.next()
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            if self._rec_iter is not None:
+                rec = next(self._rec_iter, None)
+                if rec is not None:
+                    return rec
+            chunk = self._iter.next()
+            if chunk is None:
+                return None
+            self._rec_iter = self._base.extract_records(chunk)
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        self._cache_complete = False
+        self._iter.destroy()
+        self._base.reset_partition(part_index, num_parts)
+        self._iter = ThreadedIter(self._produce_preproc, name="split-cache-build")
+        self._rec_iter = None
+
+    def total_size(self) -> int:
+        return self._base.total_size()
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        return self._base.extract_records(chunk)
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self._base.close()
+
+
+class InputSplitShuffle(InputSplit):
+    """Macro-shuffle: over-partition into num_parts * num_shuffle_parts
+    sub-parts and visit this rank's sub-parts in a seeded shuffled order,
+    reshuffled each epoch (reference include/dmlc/input_split_shuffle.h:
+    24-33, 100-119; kRandMagic_=666 :151)."""
+
+    KRAND_MAGIC = 666
+
+    def __init__(
+        self,
+        base: InputSplit,
+        part_index: int,
+        num_parts: int,
+        num_shuffle_parts: int,
+        seed: int = 0,
+    ) -> None:
+        check(num_shuffle_parts > 0, "num_shuffle_parts must be positive")
+        self._base = base
+        self._num_total = num_parts * num_shuffle_parts
+        self._sub_parts = [
+            part_index * num_shuffle_parts + i for i in range(num_shuffle_parts)
+        ]
+        self._rnd = random.Random(self.KRAND_MAGIC + seed)
+        self._order: List[int] = []
+        self._cursor = 0
+        self.before_first()
+
+    def before_first(self) -> None:
+        self._order = list(self._sub_parts)
+        self._rnd.shuffle(self._order)
+        self._cursor = 0
+        self._base.reset_partition(self._order[0], self._num_total)
+
+    def _advance(self) -> bool:
+        self._cursor += 1
+        if self._cursor >= len(self._order):
+            return False
+        self._base.reset_partition(self._order[self._cursor], self._num_total)
+        return True
+
+    def next_record(self) -> Optional[bytes]:
+        while True:
+            rec = self._base.next_record()
+            if rec is not None:
+                return rec
+            if not self._advance():
+                return None
+
+    def next_chunk(self) -> Optional[bytes]:
+        while True:
+            chunk = self._base.next_chunk()
+            if chunk is not None:
+                return chunk
+            if not self._advance():
+                return None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        nsp = len(self._sub_parts)
+        self._sub_parts = [part_index * nsp + i for i in range(nsp)]
+        self._num_total = num_parts * nsp
+        self.before_first()
+
+    def total_size(self) -> int:
+        return self._base.total_size()
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        return self._base.extract_records(chunk)
+
+    def close(self) -> None:
+        self._base.close()
+
+
+def create(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type: str = "text",
+    index_uri: Optional[str] = None,
+    shuffle: bool = False,
+    seed: int = 0,
+    batch_size: int = 256,
+    recurse_directories: bool = False,
+    num_shuffle_parts: int = 0,
+    threaded: bool = True,
+) -> InputSplit:
+    """InputSplit factory (reference InputSplit::Create, src/io.cc:81-130).
+
+    - ``uri`` may carry ``#cachefile`` sugar → CachedInputSplit
+      (reference io.cc:120-124)
+    - default wraps the split in a read-ahead thread (reference io.cc:119-122)
+    - ``type``: 'text' | 'recordio' | 'indexed_recordio'
+    """
+    spec = URISpec(uri, part_index, num_parts)
+    if type == "text" and spec.uri == "-":
+        return SingleFileSplit("-")
+    if type == "text":
+        base: InputSplitBase = LineSplitter(
+            spec.uri, part_index, num_parts, recurse_directories=recurse_directories
+        )
+    elif type == "recordio":
+        base = RecordIOSplitter(
+            spec.uri, part_index, num_parts, recurse_directories=recurse_directories
+        )
+    elif type == "indexed_recordio":
+        check(index_uri is not None, "indexed_recordio requires index_uri")
+        base = IndexedRecordIOSplitter(
+            spec.uri,
+            index_uri,  # type: ignore[arg-type]
+            part_index,
+            num_parts,
+            batch_size=batch_size,
+            shuffle=shuffle,
+            seed=seed,
+        )
+    else:
+        raise Error(f"unknown InputSplit type {type!r}")
+    split: InputSplit = base
+    if num_shuffle_parts > 0:
+        check(
+            not spec.cache_file,
+            "num_shuffle_parts with a #cachefile would freeze the first "
+            "epoch's shuffle order into the cache; pick one",
+        )
+        return InputSplitShuffle(base, part_index, num_parts, num_shuffle_parts, seed)
+    if spec.cache_file:
+        # cached OR threaded, never both: CachedInputSplit prefetches
+        # internally (reference io.cc:119-124 chooses exactly one wrapper)
+        return CachedInputSplit(base, spec.cache_file)
+    if threaded:
+        return ThreadedInputSplit(base)
+    return split

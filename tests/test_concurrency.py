@@ -1,0 +1,133 @@
+"""Tests for ThreadedIter / ThreadGroup.
+
+Modeled on reference test/unittest/unittest_threaditer.cc,
+unittest_threaditer_exc_handling.cc, unittest_thread_group.cc.
+"""
+
+import threading
+import time
+
+import pytest
+
+from dmlc_core_tpu.concurrency import (
+    ConcurrentBlockingQueue,
+    ThreadGroup,
+    ThreadedIter,
+    TimerThread,
+)
+from dmlc_core_tpu.utils import Error
+
+
+def test_threaded_iter_basic_and_restart():
+    epochs = []
+
+    def produce():
+        epochs.append(1)
+        yield from range(10)
+
+    it = ThreadedIter(produce, max_capacity=2)
+    assert list(it) == list(range(10))
+    assert it.next() is None  # stays exhausted
+    it.before_first()
+    assert list(it) == list(range(10))
+    assert len(epochs) == 2
+    it.destroy()
+
+
+def test_threaded_iter_producer_exception_propagates():
+    # reference IntProducerNextExc pattern: throw on the last element
+    def produce():
+        yield 1
+        yield 2
+        raise Error("produce failed")
+
+    it = ThreadedIter(produce)
+    assert it.next() == 1
+    assert it.next() == 2
+    with pytest.raises(Error, match="produce failed"):
+        it.next()
+    it.destroy()
+
+
+def test_threaded_iter_exception_in_first_item():
+    def produce():
+        raise ValueError("immediate")
+        yield  # pragma: no cover
+
+    it = ThreadedIter(produce)
+    with pytest.raises(ValueError, match="immediate"):
+        it.next()
+    # restart after exception works (reference exc-handling test does this)
+    ok = [False]
+
+    def produce_ok():
+        if ok[0]:
+            yield 42
+        else:
+            ok[0] = True
+            raise ValueError("first time fails")
+
+    it2 = ThreadedIter(produce_ok)
+    with pytest.raises(ValueError):
+        it2.next()
+    it2.before_first()
+    assert it2.next() == 42
+    it2.destroy()
+
+
+def test_threaded_iter_destroy_with_blocked_producer():
+    # producer blocks on the bounded queue; destroy must not hang
+    def produce():
+        yield from range(100000)
+
+    it = ThreadedIter(produce, max_capacity=2)
+    assert it.next() == 0
+    it.destroy()  # would deadlock without kill-signal draining
+
+
+def test_concurrent_blocking_queue_kill():
+    q = ConcurrentBlockingQueue(maxsize=4)
+    q.put(1)
+    assert q.pop() == 1
+    results = []
+
+    def consumer():
+        results.append(q.pop())  # blocks until kill
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.signal_for_kill()
+    t.join(timeout=2)
+    assert not t.is_alive() and results == [None]
+    assert q.pop() is None  # killed queue stays killed
+
+
+def test_thread_group_lifecycle():
+    g = ThreadGroup()
+    counter = {"n": 0}
+
+    def worker():
+        while not g.shutdown_requested.wait(0.01):
+            counter["n"] += 1
+
+    g.launch("w1", worker)
+    g.launch("w2", worker)
+    assert g.count() == 2
+    with pytest.raises(Error, match="already running"):
+        g.launch("w1", worker)
+    time.sleep(0.05)
+    g.request_shutdown_all()
+    assert g.join_all(timeout=2)
+    assert counter["n"] > 0
+    assert g.count() == 0
+
+
+def test_timer_thread_fires_periodically():
+    hits = []
+    with TimerThread(0.02, lambda: hits.append(1)):
+        time.sleep(0.13)
+    n = len(hits)
+    assert n >= 3
+    time.sleep(0.05)
+    assert len(hits) == n  # stopped
