@@ -88,18 +88,32 @@ class ThreadedIter(Generic[T]):
         except BaseException as e:  # noqa: BLE001 — crosses thread boundary
             self._put(q, kill, (_EXC, e))
 
-    def _stop(self) -> None:
+    def _stop(self) -> Optional[BaseException]:
+        """Tear down the producer; returns any pending producer exception
+        found while draining (must not be silently lost — reference
+        rethrows in BeforeFirst, threadediter.h:406-435)."""
         t = self._thread
         if t is None:
-            return
+            return None
+        pending: Optional[BaseException] = None
         self._kill.set()
         while t.is_alive():
             try:  # drain so a blocked put() notices the kill flag
-                self._queue.get_nowait()
+                tag, val = self._queue.get_nowait()
+                if tag == _EXC:
+                    pending = val
             except queue.Empty:
                 pass
             t.join(timeout=0.05)
+        while True:  # the thread may have queued items right before exiting
+            try:
+                tag, val = self._queue.get_nowait()
+                if tag == _EXC:
+                    pending = val
+            except queue.Empty:
+                break
         self._thread = None
+        return pending
 
     # -- consumer side -------------------------------------------------------
     def next(self) -> Optional[T]:
@@ -123,13 +137,19 @@ class ThreadedIter(Generic[T]):
             yield item  # type: ignore[misc]
 
     def before_first(self) -> None:
-        """Restart the producer from the beginning (reference
-        threadediter.h kBeforeFirst signal)."""
-        self._stop()
+        """Restart the producer from the beginning; re-raises a pending
+        producer exception instead of discarding it (reference
+        threadediter.h kBeforeFirst signal + ThrowExceptionIfSet)."""
+        pending = self._stop()
+        if pending is not None and not self._exhausted:
+            self._exhausted = True
+            raise pending
         self._start()
 
     def destroy(self) -> None:
-        """Tear down the producer thread (reference ~ThreadedIter)."""
+        """Tear down the producer thread (reference ~ThreadedIter).
+        Pending exceptions are intentionally dropped here — destruction
+        must not raise."""
         self._destroyed = True
         self._stop()
 

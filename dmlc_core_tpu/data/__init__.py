@@ -1,0 +1,125 @@
+"""Data layer: sparse RowBlocks, multi-threaded text parsers, row iterators.
+
+Reference: include/dmlc/data.h + src/data/ + src/data.cc (factory wiring).
+TPU-first design notes in row_block.py; the staging layer (staging/) turns
+these ragged blocks into fixed-shape device batches.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..io import split as io_split
+from ..io.uri import URISpec
+from ..params.registry import Registry
+from ..utils.logging import Error
+from .csv_parser import CSVParser, CSVParserParam
+from .libfm_parser import LibFMParser, LibFMParserParam
+from .libsvm_parser import LibSVMParser, LibSVMParserParam
+from .parser import PARSER_REGISTRY, Parser, ThreadedParser
+from .row_block import INDEX_T, REAL_T, Row, RowBlock, RowBlockContainer
+from .row_iter import BasicRowIter, DiskRowIter, RowBlockIter
+from .text_parser import TextParserBase
+
+__all__ = [
+    "Row",
+    "RowBlock",
+    "RowBlockContainer",
+    "Parser",
+    "ThreadedParser",
+    "TextParserBase",
+    "LibSVMParser",
+    "CSVParser",
+    "LibFMParser",
+    "LibSVMParserParam",
+    "CSVParserParam",
+    "LibFMParserParam",
+    "RowBlockIter",
+    "BasicRowIter",
+    "DiskRowIter",
+    "create_parser",
+    "create_row_block_iter",
+    "PARSER_REGISTRY",
+    "REAL_T",
+    "INDEX_T",
+]
+
+
+# -- parser registry (reference data.cc:223-256) -----------------------------
+def _make_text_source(uri: str, part_index: int, num_parts: int):
+    return io_split.create(uri, part_index, num_parts, type="text")
+
+
+@PARSER_REGISTRY.register("libsvm")
+def _create_libsvm(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
+    return LibSVMParser(
+        _make_text_source(uri, part_index, num_parts), args, nthread, index_dtype
+    )
+
+
+@PARSER_REGISTRY.register("csv")
+def _create_csv(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
+    return CSVParser(
+        _make_text_source(uri, part_index, num_parts), args, nthread, index_dtype
+    )
+
+
+@PARSER_REGISTRY.register("libfm")
+def _create_libfm(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
+    return LibFMParser(
+        _make_text_source(uri, part_index, num_parts), args, nthread, index_dtype
+    )
+
+
+def create_parser(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type: str = "auto",
+    nthread: Optional[int] = None,
+    index_dtype=INDEX_T,
+    threaded: bool = True,
+) -> Parser:
+    """Parser factory (reference CreateParser_, src/data.cc:62-85).
+
+    'auto' resolves ``?format=`` from the URI, defaulting to libsvm.
+    The parser is wrapped in a parse-ahead thread (reference data.cc:30-32)
+    unless ``threaded=False``.
+    """
+    spec = URISpec(uri, part_index, num_parts)
+    ptype = type
+    if ptype == "auto":
+        ptype = spec.args.get("format", "libsvm")
+    entry = PARSER_REGISTRY.find(ptype)
+    if entry is None:
+        raise Error(f"Unknown data type {ptype!r}")
+    # re-attach query args (parser params ride the URI, reference uri_spec.h)
+    base = entry(
+        spec.uri, spec.args, part_index, num_parts, nthread, index_dtype
+    )
+    return ThreadedParser(base) if threaded else base
+
+
+def create_row_block_iter(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type: str = "auto",
+    nthread: Optional[int] = None,
+    index_dtype=INDEX_T,
+) -> RowBlockIter:
+    """RowBlockIter factory (reference CreateIter_, src/data.cc:87-107):
+    ``uri#cachefile`` → DiskRowIter, else eager BasicRowIter."""
+    spec = URISpec(uri, part_index, num_parts)
+    parser = create_parser(
+        spec.uri + _requery(spec), part_index, num_parts, type, nthread, index_dtype
+    )
+    if spec.cache_file:
+        return DiskRowIter(parser, spec.cache_file, reuse_cache=True)
+    return BasicRowIter(parser)
+
+
+def _requery(spec: URISpec) -> str:
+    if not spec.args:
+        return ""
+    return "?" + "&".join(f"{k}={v}" for k, v in spec.args.items())

@@ -1,0 +1,172 @@
+"""Dense CSV format parser.
+
+Reference: src/data/csv_parser.h. Every non-label/weight column becomes a
+dense feature with running index 0..k-1; empty or non-numeric cells parse
+as 0 (matching the reference's strtof behavior). Params: ``label_column``
+(default -1 → label 0.0), ``weight_column`` (float dtype only),
+``delimiter`` (default ","). dtype ∈ {float32, int32, int64}
+(reference csv_parser.h:95-111).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import numpy as np
+
+from ..io.split import InputSplit
+from ..params.parameter import Parameter, field
+from ..utils.logging import Error, check, check_eq
+from . import native
+from .row_block import INDEX_T, REAL_T, RowBlock
+from .text_parser import TextParserBase
+
+__all__ = ["CSVParser", "CSVParserParam"]
+
+_DTYPES = {"float32": np.float32, "int32": np.int32, "int64": np.int64}
+
+_FLOAT_PREFIX = re.compile(
+    rb"[+-]?(\d+\.?\d*([eE][+-]?\d+)?|\.\d+([eE][+-]?\d+)?|inf(inity)?|nan)",
+    re.IGNORECASE,
+)
+_INT_PREFIX = re.compile(rb"([+-]?)(0[xX][0-9a-fA-F]+|[0-9]+)")
+
+
+def _parse_cell(cell: bytes, is_float: bool):
+    """C strtof/strtoll(base 0) prefix semantics (reference
+    csv_parser.h:98-106): parse the longest numeric prefix, 0 if none."""
+    if is_float:
+        try:
+            return float(cell)
+        except ValueError:
+            m = _FLOAT_PREFIX.match(cell.strip())
+            return float(m.group(0)) if m else 0.0
+    try:
+        return int(cell, 0)
+    except ValueError:
+        m = _INT_PREFIX.match(cell.strip())
+        if not m:
+            return 0
+        sign, digits = m.group(1), m.group(2)
+        if digits[:2].lower() == b"0x":
+            val = int(digits, 16)
+        elif digits.startswith(b"0") and len(digits) > 1:
+            val = int(re.match(rb"0[0-7]*", digits).group(0), 8)
+        else:
+            val = int(digits)
+        return -val if sign == b"-" else val
+
+
+class CSVParserParam(Parameter):
+    """Reference CSVParserParam (csv_parser.h:23-39)."""
+
+    format = field(str, default="csv", help="File format.")
+    label_column = field(
+        int, default=-1,
+        help="Column index (0-based) that will put into label.",
+    )
+    delimiter = field(
+        str, default=",", help="Delimiter used in the csv file."
+    )
+    weight_column = field(
+        int, default=-1,
+        help="Column index that will put into instance weights.",
+    )
+    dtype = field(
+        str, default="float32", enum={k: k for k in _DTYPES},
+        help="Value dtype (reference DType dispatch, data.cc:138-210).",
+    )
+
+
+class CSVParser(TextParserBase):
+    def __init__(
+        self,
+        source: InputSplit,
+        args: Optional[dict] = None,
+        nthread: Optional[int] = None,
+        index_dtype=INDEX_T,
+    ) -> None:
+        super().__init__(source, nthread)
+        self.param = CSVParserParam()
+        self.param.init(args or {}, allow_unknown=True)
+        check_eq(self.param.format, "csv", "format mismatch")
+        check(
+            self.param.label_column != self.param.weight_column
+            or self.param.label_column < 0,
+            "Must have distinct columns for labels and instance weights",
+        )
+        check_eq(len(self.param.delimiter), 1, "delimiter must be one char")
+        self.dtype = _DTYPES[self.param.dtype]
+        self.index_dtype = index_dtype
+
+    def parse_block(self, data: bytes) -> RowBlock:
+        if native.AVAILABLE and self.param.dtype == "float32":
+            arrays = native.parse_csv(
+                data,
+                ord(self.param.delimiter),
+                self.param.label_column,
+                self.param.weight_column,
+            )
+            if arrays is not None:
+                offset, label, weight, index, value = arrays
+                return RowBlock(
+                    offset=offset,
+                    label=label,
+                    index=index.astype(self.index_dtype, copy=False),
+                    value=value,
+                    weight=weight,
+                )
+        return self._parse_block_py(data)
+
+    def _parse_block_py(self, data: bytes) -> RowBlock:
+        delim = self.param.delimiter.encode()
+        lcol, wcol = self.param.label_column, self.param.weight_column
+        is_float = self.dtype == np.float32
+        labels = []
+        weights = []
+        index = []
+        values = []
+        offset = [0]
+        any_weight = False
+        for line in data.splitlines():
+            if not line:
+                continue
+            cells = line.split(delim)
+            label = 0.0
+            weight = None
+            k = 0
+            for col, cell in enumerate(cells):
+                v = _parse_cell(cell, is_float)
+                if col == lcol:
+                    label = v
+                elif is_float and col == wcol:
+                    weight = v
+                    any_weight = True
+                else:
+                    values.append(v)
+                    index.append(k)
+                    k += 1
+            if len(cells) == 1 and k == 0:
+                # reference csv_parser.h:123-126: fatal only when the line
+                # yields no feature at all
+                raise Error(
+                    f"Delimiter {self.param.delimiter!r} is not found in "
+                    "the line. Expected it to separate fields."
+                )
+            labels.append(label)
+            weights.append(weight)
+            offset.append(len(index))
+        return RowBlock(
+            offset=np.asarray(offset, dtype=np.int64),
+            label=np.asarray(labels, dtype=REAL_T),
+            index=np.asarray(index, dtype=self.index_dtype),
+            value=np.asarray(values, dtype=self.dtype),
+            weight=(
+                np.asarray(
+                    [1.0 if w is None else w for w in weights], dtype=REAL_T
+                )
+                if any_weight
+                else None
+            ),
+        )

@@ -1,0 +1,134 @@
+"""LibFM text format parser.
+
+Reference: src/data/libfm_parser.h. Line grammar::
+
+    label[:weight] field:index[:value] field:index[:value] ...
+
+Tokens with fewer than two numbers are skipped (reference ParseTriple r<=1,
+libfm_parser.h:109-113). ``indexing_mode`` as in libsvm, but auto-detect
+requires BOTH all field ids and all feature ids > 0
+(libfm_parser.h:132-144).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..io.split import InputSplit
+from ..params.parameter import Parameter, field
+from ..utils.logging import check_eq
+from . import native
+from .row_block import INDEX_T, REAL_T, RowBlock
+from .strtonum import parse_pair, parse_triple
+from .text_parser import TextParserBase
+
+__all__ = ["LibFMParser", "LibFMParserParam"]
+
+
+class LibFMParserParam(Parameter):
+    """Reference LibFMParserParam (libfm_parser.h:24-39)."""
+
+    format = field(str, default="libfm", help="File format")
+    indexing_mode = field(
+        int,
+        default=0,
+        help=(
+            "If >0, treat all field and feature indices as 1-based. "
+            "If =0, 0-based. If <0, auto-detect."
+        ),
+    )
+
+
+class LibFMParser(TextParserBase):
+    def __init__(
+        self,
+        source: InputSplit,
+        args: Optional[dict] = None,
+        nthread: Optional[int] = None,
+        index_dtype=INDEX_T,
+    ) -> None:
+        super().__init__(source, nthread)
+        self.param = LibFMParserParam()
+        self.param.init(args or {}, allow_unknown=True)
+        check_eq(self.param.format, "libfm", "format mismatch")
+        self.index_dtype = index_dtype
+
+    def parse_block(self, data: bytes) -> RowBlock:
+        if native.AVAILABLE:
+            arrays = native.parse_libfm(data, self.param.indexing_mode)
+            if arrays is not None:
+                offset, label, weight, fields, index, value = arrays
+                return RowBlock(
+                    offset=offset,
+                    label=label,
+                    index=index.astype(self.index_dtype, copy=False),
+                    value=value,
+                    weight=weight,
+                    field=fields,
+                )
+        return self._parse_block_py(data)
+
+    def _parse_block_py(self, data: bytes) -> RowBlock:
+        labels = []
+        weights = []
+        fields = []
+        index = []
+        values = []
+        offset = [0]
+        any_value = False
+        for line in data.splitlines():
+            toks = line.split()
+            if not toks:
+                continue
+            lw = parse_pair(toks[0])
+            if lw is None:
+                continue
+            label, weight = lw
+            for t in toks[1:]:
+                triple = parse_triple(t)
+                if triple is None:
+                    continue
+                fid, feat, val = triple
+                fields.append(fid)
+                index.append(feat)
+                values.append(val)
+                if val is not None:
+                    any_value = True
+            labels.append(label)
+            weights.append(weight)
+            offset.append(len(index))
+        field_arr = np.asarray(fields, dtype=np.int64)
+        idx_arr = np.asarray(index, dtype=np.int64)
+        mode = self.param.indexing_mode
+        if mode > 0 or (
+            mode < 0
+            and len(idx_arr)
+            and idx_arr.min() > 0
+            and len(field_arr)
+            and field_arr.min() > 0
+        ):
+            idx_arr = idx_arr - 1
+            field_arr = field_arr - 1
+        has_weight = any(w is not None for w in weights)
+        return RowBlock(
+            offset=np.asarray(offset, dtype=np.int64),
+            label=np.asarray(labels, dtype=REAL_T),
+            index=idx_arr.astype(self.index_dtype, copy=False),
+            value=(
+                np.asarray(
+                    [1.0 if v is None else v for v in values], dtype=REAL_T
+                )
+                if any_value
+                else None
+            ),
+            weight=(
+                np.asarray(
+                    [1.0 if w is None else w for w in weights], dtype=REAL_T
+                )
+                if has_weight
+                else None
+            ),
+            field=field_arr,
+        )

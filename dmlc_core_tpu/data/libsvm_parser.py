@@ -1,0 +1,163 @@
+"""LibSVM text format parser.
+
+Reference: src/data/libsvm_parser.h. Line grammar::
+
+    label[:weight] [qid:n] index[:value] index[:value] ...  [# comment]
+
+- ``#`` starts a comment; blank / comment-only lines are skipped
+  (reference IgnoreCommentAndBlank, libsvm_parser.h:87-103).
+- Features may omit ``:value`` (binary features, value treated as 1.0 —
+  reference data.h:120-127). Divergence from the reference: a block mixing
+  valued and unvalued features gets 1.0 filled in for the unvalued ones
+  (the reference silently misaligns arrays in that case).
+- ``indexing_mode`` param: >0 forces 1-based, 0 forces 0-based, <0
+  auto-detects à la sklearn.load_svmlight_file (all ids > 0 ⇒ 1-based;
+  reference libsvm_parser.h:159-168).
+
+The native C++ core (native/fastparse.cc) replaces ``parse_block`` when
+loaded; this numpy/bytes implementation is the semantic definition.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..io.split import InputSplit
+from ..params.parameter import Parameter, field
+from ..utils.logging import check, check_eq
+from . import native
+from .row_block import INDEX_T, REAL_T, RowBlock
+from .strtonum import parse_pair
+from .text_parser import TextParserBase
+
+__all__ = ["LibSVMParser", "LibSVMParserParam"]
+
+
+class LibSVMParserParam(Parameter):
+    """Reference LibSVMParserParam (libsvm_parser.h:24-39)."""
+
+    format = field(str, default="libsvm", help="File format")
+    indexing_mode = field(
+        int,
+        default=0,
+        help=(
+            "If >0, treat all feature indices as 1-based. If =0, 0-based. "
+            "If <0, auto-detect (all ids > 0 means 1-based)."
+        ),
+    )
+
+
+class LibSVMParser(TextParserBase):
+    def __init__(
+        self,
+        source: InputSplit,
+        args: Optional[dict] = None,
+        nthread: Optional[int] = None,
+        index_dtype=INDEX_T,
+    ) -> None:
+        super().__init__(source, nthread)
+        self.param = LibSVMParserParam()
+        self.param.init(args or {}, allow_unknown=True)
+        check_eq(self.param.format, "libsvm", "format mismatch")
+        self.index_dtype = index_dtype
+
+    def parse_block(self, data: bytes) -> RowBlock:
+        if native.AVAILABLE:
+            arrays = native.parse_libsvm(data, self.param.indexing_mode)
+            if arrays is not None:
+                return self._block_from_native(arrays)
+        return self._parse_block_py(data)
+
+    def _block_from_native(self, arrays) -> RowBlock:
+        offset, label, weight, qid, index, value = arrays
+        return RowBlock(
+            offset=offset,
+            label=label,
+            index=index.astype(self.index_dtype, copy=False),
+            value=value,
+            weight=weight,
+            qid=qid,
+        )
+
+    def _parse_block_py(self, data: bytes) -> RowBlock:
+        labels = []
+        weights = []
+        qids = []
+        index = []
+        values = []
+        offset = [0]
+        any_value = False
+        min_feat = None
+        for line in data.splitlines():
+            hash_pos = line.find(b"#")
+            if hash_pos >= 0:
+                line = line[:hash_pos]
+            toks = line.split()
+            if not toks:
+                continue
+            lw = parse_pair(toks[0])
+            if lw is None:
+                continue
+            label, weight = lw
+            start = 1
+            qid = None
+            if len(toks) > 1 and toks[1].startswith(b"qid:"):
+                try:
+                    qid = int(toks[1][4:])
+                except ValueError:
+                    qid = 0  # reference atoll on garbage -> 0, keep parsing
+                start = 2
+            row_vals = []
+            for t in toks[start:]:
+                c = t.find(b":")
+                try:
+                    if c < 0:
+                        feat, val = int(t), None
+                    else:
+                        feat, val = int(t[:c]), float(t[c + 1:])
+                except ValueError:
+                    continue  # non-numeric token: reference ParsePair r<1 skip
+                index.append(feat)
+                row_vals.append(val)
+            if any(v is not None for v in row_vals):
+                any_value = True
+            values.extend(row_vals)
+            labels.append(label)
+            weights.append(weight)
+            qids.append(qid)
+            offset.append(len(index))
+        idx_arr = np.asarray(index, dtype=np.int64)
+        if len(idx_arr):
+            min_feat = int(idx_arr.min())
+        mode = self.param.indexing_mode
+        if mode > 0 or (mode < 0 and min_feat is not None and min_feat > 0):
+            idx_arr = idx_arr - 1
+        value_arr = (
+            np.asarray(
+                [1.0 if v is None else v for v in values], dtype=REAL_T
+            )
+            if any_value
+            else None
+        )
+        has_weight = any(w is not None for w in weights)
+        has_qid = any(q is not None for q in qids)
+        return RowBlock(
+            offset=np.asarray(offset, dtype=np.int64),
+            label=np.asarray(labels, dtype=REAL_T),
+            index=idx_arr.astype(self.index_dtype, copy=False),
+            value=value_arr,
+            weight=(
+                np.asarray(
+                    [1.0 if w is None else w for w in weights], dtype=REAL_T
+                )
+                if has_weight
+                else None
+            ),
+            qid=(
+                np.asarray([0 if q is None else q for q in qids], np.int64)
+                if has_qid
+                else None
+            ),
+        )

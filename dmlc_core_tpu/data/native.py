@@ -1,0 +1,155 @@
+"""ctypes bridge to the native C++ parse core (native/libdmlc_tpu_native.so).
+
+The native library implements the hot loops — libsvm/csv/libfm chunk parsing
+into CSR arrays — releasing the GIL so TextParserBase's thread fan-out gets
+real parallelism (the reference gets this from std::thread,
+src/data/text_parser.h:110-146). Every entry point has a pure-Python
+fallback in the corresponding parser module; if the library is missing or
+fails to load, AVAILABLE stays False and nothing breaks.
+
+Calling convention: the caller passes the chunk buffer; the library parses
+into library-owned growable buffers and returns sizes; the bridge copies
+into fresh numpy arrays and frees the native buffers. One copy per ~8MB
+chunk is noise next to parse cost, and fresh arrays keep ownership simple.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["AVAILABLE", "parse_libsvm", "parse_csv", "parse_libfm", "load"]
+
+AVAILABLE = False
+_LIB = None
+_LOCK = threading.Lock()
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_CANDIDATES = (
+    os.path.join(_REPO_ROOT, "native", "libdmlc_tpu_native.so"),
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "libdmlc_tpu_native.so"),
+)
+
+
+class _ParseResult(ctypes.Structure):
+    """Mirrors native/fastparse.cc struct ParseResult."""
+
+    _fields_ = [
+        ("n_rows", ctypes.c_int64),
+        ("n_elems", ctypes.c_int64),
+        ("offset", ctypes.POINTER(ctypes.c_int64)),
+        ("label", ctypes.POINTER(ctypes.c_float)),
+        ("weight", ctypes.POINTER(ctypes.c_float)),
+        ("qid", ctypes.POINTER(ctypes.c_int64)),
+        ("field", ctypes.POINTER(ctypes.c_int64)),
+        ("index", ctypes.POINTER(ctypes.c_uint64)),
+        ("value", ctypes.POINTER(ctypes.c_float)),
+        ("has_weight", ctypes.c_int32),
+        ("has_qid", ctypes.c_int32),
+        ("has_field", ctypes.c_int32),
+        ("has_value", ctypes.c_int32),
+        ("error", ctypes.c_char_p),
+    ]
+
+
+def load(path: Optional[str] = None) -> bool:
+    """Load the native library (idempotent). Returns availability."""
+    global AVAILABLE, _LIB
+    with _LOCK:
+        if _LIB is not None:
+            return AVAILABLE
+        if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
+            return False
+        paths = (path,) if path else _CANDIDATES
+        for p in paths:
+            if p is None or not os.path.exists(p):
+                continue
+            try:
+                lib = ctypes.CDLL(p)
+            except OSError:
+                continue
+            for fn in ("dmlc_parse_libsvm", "dmlc_parse_csv", "dmlc_parse_libfm"):
+                getattr(lib, fn).restype = ctypes.POINTER(_ParseResult)
+            lib.dmlc_parse_libsvm.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
+            lib.dmlc_parse_csv.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int32]
+            lib.dmlc_parse_libfm.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
+            lib.dmlc_free_result.argtypes = [ctypes.POINTER(_ParseResult)]
+            lib.dmlc_free_result.restype = None
+            _LIB = lib
+            AVAILABLE = True
+            return True
+        return False
+
+
+def _copy_out(res_ptr):
+    """ParseResult → numpy arrays (copies), then free native buffers."""
+    res = res_ptr.contents
+    try:
+        if res.error:
+            from ..utils.logging import Error
+
+            raise Error(res.error.decode())
+        n, m = res.n_rows, res.n_elems
+        offset = np.ctypeslib.as_array(res.offset, (n + 1,)).copy()
+        label = np.ctypeslib.as_array(res.label, (n,)).copy() if n else np.empty(0, np.float32)
+        weight = (
+            np.ctypeslib.as_array(res.weight, (n,)).copy()
+            if res.has_weight and n else None
+        )
+        qid = (
+            np.ctypeslib.as_array(res.qid, (n,)).copy()
+            if res.has_qid and n else None
+        )
+        field = (
+            np.ctypeslib.as_array(res.field, (m,)).copy()
+            if res.has_field and m else (np.empty(0, np.int64) if res.has_field else None)
+        )
+        index = (
+            np.ctypeslib.as_array(res.index, (m,)).copy()
+            if m else np.empty(0, np.uint64)
+        )
+        value = (
+            np.ctypeslib.as_array(res.value, (m,)).copy()
+            if res.has_value and m else (np.empty(0, np.float32) if res.has_value else None)
+        )
+        return offset, label, weight, qid, field, index, value
+    finally:
+        _LIB.dmlc_free_result(res_ptr)
+
+
+def parse_libsvm(data: bytes, indexing_mode: int):
+    """→ (offset, label, weight, qid, index, value) or None if unavailable."""
+    if not AVAILABLE:
+        return None
+    res = _LIB.dmlc_parse_libsvm(data, len(data), indexing_mode)
+    offset, label, weight, qid, _field, index, value = _copy_out(res)
+    return offset, label, weight, qid, index, value
+
+
+def parse_csv(data: bytes, delimiter: int, label_column: int, weight_column: int):
+    """→ (offset, label, weight, index, value) or None if unavailable."""
+    if not AVAILABLE:
+        return None
+    res = _LIB.dmlc_parse_csv(data, len(data), delimiter, label_column, weight_column)
+    offset, label, weight, _qid, _field, index, value = _copy_out(res)
+    return offset, label, weight, index, value
+
+
+def parse_libfm(data: bytes, indexing_mode: int):
+    """→ (offset, label, weight, field, index, value) or None."""
+    if not AVAILABLE:
+        return None
+    res = _LIB.dmlc_parse_libfm(data, len(data), indexing_mode)
+    offset, label, weight, _qid, field, index, value = _copy_out(res)
+    return offset, label, weight, field, index, value
+
+
+load()

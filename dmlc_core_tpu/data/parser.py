@@ -1,0 +1,91 @@
+"""Parser interfaces + threaded parse-ahead wrapper.
+
+Reference: include/dmlc/data.h:280-361 (Parser interface + registry),
+src/data/parser.h (ParserImpl, ThreadedParser).
+
+A Parser is a pull iterator of RowBlock batches. ``ThreadedParser`` moves
+parsing onto a background thread with a bounded queue of 8 batches
+(reference parser.h:75), so downstream batching/staging overlaps with parse.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..concurrency.threaded_iter import ThreadedIter
+from ..params.registry import Registry
+from .row_block import RowBlock
+
+__all__ = ["Parser", "ThreadedParser", "PARSER_REGISTRY"]
+
+# reference data.h:341-356 ParserFactoryReg; entries registered in __init__.py
+PARSER_REGISTRY: Registry = Registry("parser")
+
+
+class Parser:
+    """Pull interface producing lists of RowBlocks (reference
+    data.h:293-320, parser.h:24-68)."""
+
+    def parse_next(self) -> Optional[List[RowBlock]]:
+        """Parse the next batch of blocks; None at end of data."""
+        raise NotImplementedError
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def bytes_read(self) -> int:
+        """Bytes of source consumed so far (throughput accounting,
+        reference data.h:310-312)."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        """Iterate single RowBlocks (flattened batches)."""
+        while True:
+            blocks = self.parse_next()
+            if blocks is None:
+                return
+            for b in blocks:
+                if b.size:
+                    yield b
+
+    def close(self) -> None:
+        pass
+
+
+class ThreadedParser(Parser):
+    """Parse-ahead wrapper: base parser runs on a producer thread, batches
+    cross to the consumer via a bounded queue (reference ThreadedParser,
+    src/data/parser.h:71-126, capacity 8)."""
+
+    def __init__(self, base: Parser, max_capacity: int = 8) -> None:
+        self._base = base
+        self._first_epoch = True
+        self._iter: ThreadedIter[List[RowBlock]] = ThreadedIter(
+            self._produce, max_capacity=max_capacity, name="threaded-parser"
+        )
+
+    def _produce(self):
+        # skip the rewind on the very first epoch so non-rewindable sources
+        # (stdin) work; same guard as ThreadedInputSplit (io/split.py)
+        if self._first_epoch:
+            self._first_epoch = False
+        else:
+            self._base.before_first()
+        while True:
+            blocks = self._base.parse_next()
+            if blocks is None:
+                return
+            yield blocks
+
+    def parse_next(self) -> Optional[List[RowBlock]]:
+        return self._iter.next()
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    def bytes_read(self) -> int:
+        return self._base.bytes_read()
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self._base.close()

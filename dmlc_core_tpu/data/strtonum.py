@@ -1,0 +1,53 @@
+"""Token parsing helpers for text formats.
+
+Reference: include/dmlc/strtonum.h — locale-independent ParseFloat/ParsePair
+(:656-681) / ParseTriple (:697-737), the hot inner loop of all text parsers.
+
+The TPU build's true hot loop lives in the native C++ core (native/); these
+Python helpers define the exact semantics and serve as the fallback. Python's
+float() is already locale-independent, matching the reference's motivation
+for hand-rolled strtof.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["parse_pair", "parse_triple"]
+
+
+def parse_pair(token: bytes) -> Optional[Tuple[float, Optional[float]]]:
+    """Parse ``a`` or ``a:b`` (reference ParsePair, strtonum.h:656-681).
+
+    Returns (a, None) / (a, b), or None when the token is not numeric
+    (the reference's r<1 'empty' result)."""
+    c = token.find(b":")
+    try:
+        if c < 0:
+            return float(token), None
+        return float(token[:c]), float(token[c + 1:])
+    except ValueError:
+        return None
+
+
+def parse_triple(
+    token: bytes,
+) -> Optional[Tuple[int, int, Optional[float]]]:
+    """Parse ``a:b`` or ``a:b:c`` (reference ParseTriple, strtonum.h:697-737).
+
+    Returns (a, b, None) / (a, b, c); None when fewer than two numbers parse
+    (the reference's r<=1 skip)."""
+    c1 = token.find(b":")
+    if c1 < 0:
+        return None
+    c2 = token.find(b":", c1 + 1)
+    try:
+        if c2 < 0:
+            return int(token[:c1]), int(token[c1 + 1:]), None
+        return (
+            int(token[:c1]),
+            int(token[c1 + 1: c2]),
+            float(token[c2 + 1:]),
+        )
+    except ValueError:
+        return None

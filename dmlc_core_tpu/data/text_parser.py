@@ -1,0 +1,114 @@
+"""Text parser base: chunked, multi-threaded line parsing.
+
+Reference: src/data/text_parser.h. ``fill_data`` pulls one ~8MB chunk from the
+InputSplit, splits it at line boundaries into N slices, and parses slices in
+parallel into RowBlocks. With the native C++ core loaded (native/), slice
+parsing releases the GIL and the thread fan-out gives true parallelism; the
+pure-Python fallback keeps identical semantics.
+
+Worker exceptions propagate to the caller (reference OMPException,
+include/dmlc/common.h:53-87) via concurrent.futures result().
+"""
+
+from __future__ import annotations
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from ..io.split import InputSplit
+from ..utils.logging import check
+from .parser import Parser
+from .row_block import RowBlock
+
+__all__ = ["TextParserBase", "default_parser_threads"]
+
+_BOM = b"\xef\xbb\xbf"
+
+
+def default_parser_threads(nthread: Optional[int]) -> int:
+    """Reference heuristic (text_parser.h:33-34):
+    min(requested, max(procs/2 - 4, 1)); requested defaults to 2
+    (src/data.cc:29)."""
+    if nthread is None:
+        nthread = 2
+    procs = os.cpu_count() or 1
+    return max(1, min(nthread, max(procs // 2 - 4, 1)))
+
+
+class TextParserBase(Parser):
+    """Chunk → line-aligned slices → parallel parse_block
+    (reference text_parser.h:110-146)."""
+
+    def __init__(self, source: InputSplit, nthread: Optional[int] = None) -> None:
+        self.source = source
+        self.nthread = default_parser_threads(nthread)
+        self._bytes_read = 0
+        self._pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(max_workers=self.nthread, thread_name_prefix="parse")
+            if self.nthread > 1
+            else None
+        )
+
+    # -- subclass hook -------------------------------------------------------
+    def parse_block(self, data: bytes) -> RowBlock:
+        """Parse a byte slice of whole lines into one RowBlock."""
+        raise NotImplementedError
+
+    # -- Parser interface ----------------------------------------------------
+    def bytes_read(self) -> int:
+        return self._bytes_read
+
+    def before_first(self) -> None:
+        self.source.before_first()
+        self._bytes_read = 0
+
+    def parse_next(self) -> Optional[List[RowBlock]]:
+        return self.fill_data()
+
+    def fill_data(self) -> Optional[List[RowBlock]]:
+        """One chunk, fanned out across parser threads (reference
+        FillData, text_parser.h:110-146)."""
+        chunk = self.source.next_chunk()
+        if chunk is None:
+            return None
+        self._bytes_read += len(chunk)
+        if chunk.startswith(_BOM):  # UTF-8 BOM skip (text_parser.h:81-95)
+            chunk = chunk[len(_BOM):]
+        slices = self._split_slices(chunk, self.nthread)
+        if self._pool is None or len(slices) == 1:
+            return [self.parse_block(s) for s in slices]
+        futures = [self._pool.submit(self.parse_block, s) for s in slices]
+        return [f.result() for f in futures]  # re-raises worker exceptions
+
+    @staticmethod
+    def _split_slices(chunk: bytes, nslice: int) -> List[bytes]:
+        """Cut a chunk into ≤nslice pieces ending at line boundaries
+        (reference BackFindEndLine usage, text_parser.h:120-133)."""
+        n = len(chunk)
+        if nslice <= 1 or n < 4096:
+            return [chunk] if n else []
+        step = (n + nslice - 1) // nslice
+        out: List[bytes] = []
+        begin = 0
+        while begin < n:
+            end = min(begin + step, n)
+            if end < n:
+                nl = chunk.rfind(b"\n", begin, end)
+                if nl < 0:
+                    # no newline inside the slice: extend to the next one
+                    nl = chunk.find(b"\n", end)
+                    end = n if nl < 0 else nl + 1
+                else:
+                    end = nl + 1
+            piece = chunk[begin:end]
+            if piece:
+                out.append(piece)
+            begin = end
+        return out
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        self.source.close()
