@@ -11,7 +11,6 @@ from typing import Optional
 
 from ..io import split as io_split
 from ..io.uri import URISpec
-from ..params.registry import Registry
 from ..utils.logging import Error
 from .csv_parser import CSVParser, CSVParserParam
 from .libfm_parser import LibFMParser, LibFMParserParam
@@ -111,12 +110,21 @@ def create_row_block_iter(
     """RowBlockIter factory (reference CreateIter_, src/data.cc:87-107):
     ``uri#cachefile`` → DiskRowIter, else eager BasicRowIter."""
     spec = URISpec(uri, part_index, num_parts)
-    parser = create_parser(
-        spec.uri + _requery(spec), part_index, num_parts, type, nthread, index_dtype
-    )
+
+    def make_parser() -> Parser:
+        return create_parser(
+            spec.uri + _requery(spec),
+            part_index,
+            num_parts,
+            type,
+            nthread,
+            index_dtype,
+        )
+
     if spec.cache_file:
-        return DiskRowIter(parser, spec.cache_file, reuse_cache=True)
-    return BasicRowIter(parser)
+        # factory form: a warm cache never touches the raw data source
+        return DiskRowIter(make_parser, spec.cache_file, reuse_cache=True)
+    return BasicRowIter(make_parser())
 
 
 def _requery(spec: URISpec) -> str:

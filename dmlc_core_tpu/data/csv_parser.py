@@ -147,8 +147,8 @@ class CSVParser(TextParserBase):
                     values.append(v)
                     index.append(k)
                     k += 1
-            if len(cells) == 1 and k == 0:
-                # reference csv_parser.h:123-126: fatal only when the line
+            if k == 0:
+                # reference csv_parser.h:123-126: fatal whenever a line
                 # yields no feature at all
                 raise Error(
                     f"Delimiter {self.param.delimiter!r} is not found in "

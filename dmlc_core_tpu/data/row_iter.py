@@ -10,10 +10,10 @@ before_first / next() → RowBlock / num_col.
 from __future__ import annotations
 
 import os
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional, Union
 
 from ..concurrency.threaded_iter import ThreadedIter
-from ..io.stream import FileStream, SeekStream, Stream
+from ..io.stream import FileStream
 from ..utils.logging import check, log_info
 from ..utils.timer import get_time
 from .parser import Parser
@@ -101,18 +101,26 @@ class DiskRowIter(RowBlockIter):
     via a prefetch thread (reference disk_row_iter.h)."""
 
     def __init__(
-        self, parser: Parser, cache_file: str, reuse_cache: bool = True
+        self,
+        parser: Union[Parser, Callable[[], Parser]],
+        cache_file: str,
+        reuse_cache: bool = True,
     ) -> None:
+        """``parser`` may be a factory so the warm-cache path never opens
+        (or starts prefetching from) the raw data source at all."""
         self.cache_file = cache_file
         self._num_col = 0
         meta = cache_file + ".meta"
         if not (reuse_cache and self._try_load_meta(meta)):
-            self._build_cache(parser, meta)
+            p = parser() if callable(parser) else parser
+            self._build_cache(p, meta)
+            p.close()
             check(
                 os.path.exists(cache_file),
                 f"failed to build cache file {cache_file}",
             )
-        parser.close()
+        elif not callable(parser):
+            parser.close()
         self._iter: ThreadedIter[RowBlock] = ThreadedIter(
             self._read_pages, max_capacity=2, name="disk-row-iter"
         )
@@ -120,8 +128,11 @@ class DiskRowIter(RowBlockIter):
     def _try_load_meta(self, meta: str) -> bool:
         if not (os.path.exists(self.cache_file) and os.path.exists(meta)):
             return False
-        with open(meta, "r") as f:
-            self._num_col = int(f.read().strip())
+        try:
+            with open(meta, "r") as f:
+                self._num_col = int(f.read().strip())
+        except (ValueError, OSError):
+            return False  # truncated/corrupt meta: rebuild the cache
         return True
 
     def _build_cache(self, parser: Parser, meta: str) -> None:

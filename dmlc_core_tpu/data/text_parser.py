@@ -17,7 +17,6 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
 from ..io.split import InputSplit
-from ..utils.logging import check
 from .parser import Parser
 from .row_block import RowBlock
 
@@ -72,8 +71,11 @@ class TextParserBase(Parser):
         chunk = self.source.next_chunk()
         if chunk is None:
             return None
+        first_chunk = self._bytes_read == 0
         self._bytes_read += len(chunk)
-        if chunk.startswith(_BOM):  # UTF-8 BOM skip (text_parser.h:81-95)
+        if first_chunk and chunk.startswith(_BOM):
+            # UTF-8 BOM skip, beginning of input only (text_parser.h:81-95);
+            # later chunks may legitimately start with these bytes
             chunk = chunk[len(_BOM):]
         slices = self._split_slices(chunk, self.nthread)
         if self._pool is None or len(slices) == 1:

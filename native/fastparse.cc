@@ -1,0 +1,445 @@
+// Native parse core for dmlc_core_tpu: text chunk -> CSR arrays.
+//
+// TPU-native equivalent of the reference's C++ parser hot loops
+// (reference: src/data/libsvm_parser.h, csv_parser.h, libfm_parser.h and
+// include/dmlc/strtonum.h — behavior re-implemented fresh, not copied).
+// Called from Python via ctypes (dmlc_core_tpu/data/native.py); each call
+// parses one line-aligned slice and the Python-side thread pool provides
+// the fan-out (ctypes releases the GIL for the duration of the call).
+//
+// Semantics contract: must match the pure-Python fallbacks in
+// dmlc_core_tpu/data/{libsvm,csv,libfm}_parser.py exactly; the parity is
+// enforced by tests/test_native.py which parses identical inputs both ways.
+
+#include <charconv>
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#define DMLC_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+// POD view handed to ctypes; field order mirrors _ParseResult in
+// dmlc_core_tpu/data/native.py.
+struct ParseResult {
+  int64_t n_rows;
+  int64_t n_elems;
+  int64_t* offset;
+  float* label;
+  float* weight;
+  int64_t* qid;
+  int64_t* field;
+  uint64_t* index;
+  float* value;
+  int32_t has_weight;
+  int32_t has_qid;
+  int32_t has_field;
+  int32_t has_value;
+  const char* error;
+};
+
+// Owns the storage; ParseResult is the first member so the C API can hand
+// out &holder->res and free via a cast back.
+struct Holder {
+  ParseResult res{};
+  std::vector<int64_t> offset;
+  std::vector<float> label;
+  std::vector<float> weight;
+  std::vector<int64_t> qid;
+  std::vector<int64_t> field;
+  std::vector<uint64_t> index;
+  std::vector<float> value;
+  std::string error_msg;
+};
+
+ParseResult* finish(Holder* h) {
+  ParseResult& r = h->res;
+  r.n_rows = static_cast<int64_t>(h->label.size());
+  r.n_elems = static_cast<int64_t>(h->index.size());
+  r.offset = h->offset.data();
+  r.label = h->label.data();
+  r.weight = h->weight.data();
+  r.qid = h->qid.data();
+  r.field = h->field.data();
+  r.index = h->index.data();
+  r.value = h->value.data();
+  if (!h->error_msg.empty()) r.error = h->error_msg.c_str();
+  return &r;
+}
+
+inline bool is_blank(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+
+// -- number parsing ----------------------------------------------------------
+
+// std::from_chars rejects a leading '+' that Python float()/int() and C
+// strtof/strtoll all accept; strip it (but not a '+' followed by another
+// sign, which nothing accepts).
+inline const char* skip_plus(const char* b, const char* e) {
+  if (b != e && *b == '+' && b + 1 != e && b[1] != '+' && b[1] != '-') ++b;
+  return b;
+}
+
+// Exact fast path for plain decimals: [sign] up-to-15 digits with one
+// optional dot, no exponent. mantissa < 10^15 < 2^53 and the 10^k divisor
+// are both exact doubles, so one division gives the correctly-rounded
+// result — bit-identical to from_chars. Everything else returns false.
+inline bool parse_float_simple(const char* b, const char* e, double* out) {
+  static constexpr double kPow10[23] = {
+      1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+      1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+      1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+  const char* p = b;
+  bool neg = false;
+  if (p != e && (*p == '+' || *p == '-')) neg = (*p++ == '-');
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool seen_dot = false, any = false;
+  for (; p != e; ++p) {
+    const char c = *p;
+    if (c >= '0' && c <= '9') {
+      if (++digits > 15) return false;
+      mant = mant * 10 + static_cast<uint64_t>(c - '0');
+      any = true;
+      if (seen_dot) ++frac;
+    } else if (c == '.' && !seen_dot) {
+      seen_dot = true;
+    } else {
+      return false;  // exponent / junk: slow path decides
+    }
+  }
+  if (!any) return false;
+  const double v = static_cast<double>(mant) / kPow10[frac];
+  *out = neg ? -v : v;
+  return true;
+}
+
+// Full-token float parse (Python float() semantics: whole token or fail).
+inline bool parse_float_full(const char* b, const char* e, double* out) {
+  while (b != e && is_blank(*b)) ++b;
+  while (e != b && is_blank(*(e - 1))) --e;
+  if (parse_float_simple(b, e, out)) return true;
+  b = skip_plus(b, e);
+  if (b == e) return false;
+  auto [ptr, ec] = std::from_chars(b, e, *out);
+  return ec == std::errc() && ptr == e;
+}
+
+// Longest-prefix float parse (C strtof semantics: 0.0 when nothing parses).
+inline double parse_float_prefix(const char* b, const char* e) {
+  while (b != e && is_blank(*b)) ++b;
+  b = skip_plus(b, e);
+  double v = 0.0;
+  auto [ptr, ec] = std::from_chars(b, e, v);
+  (void)ptr;
+  return ec == std::errc() ? v : 0.0;
+}
+
+// Full-token base-10 integer parse (Python int() semantics).
+inline bool parse_i64_full(const char* b, const char* e, int64_t* out) {
+  while (b != e && is_blank(*b)) ++b;
+  while (e != b && is_blank(*(e - 1))) --e;
+  b = skip_plus(b, e);
+  if (b == e) return false;
+  auto [ptr, ec] = std::from_chars(b, e, *out, 10);
+  return ec == std::errc() && ptr == e;
+}
+
+// Python int(cell, 0): full token, prefixes 0x/0o/0b, leading-0 decimal
+// rejected. Fallback to C strtoll(base 0) prefix semantics on failure
+// (hex 0x, octal leading-0, else decimal; 0 when nothing parses). This is
+// the pair of attempts the Python CSV fallback makes (_parse_cell).
+inline int64_t parse_int_cell(const char* b, const char* e) {
+  const char* p = b;
+  while (p != e && is_blank(*p)) ++p;
+  const char* q = e;
+  while (q != p && is_blank(*(q - 1))) --q;
+  bool neg = false;
+  if (p != q && (*p == '+' || *p == '-')) neg = (*p++ == '-');
+  int64_t v = 0;
+  if (p != q) {
+    // try Python-style full parse first
+    if (*p == '0' && q - p >= 2 && (p[1] == 'x' || p[1] == 'X')) {
+      auto [ptr, ec] = std::from_chars(p + 2, q, v, 16);
+      if (ec == std::errc() && ptr == q) return neg ? -v : v;
+    } else if (*p == '0' && q - p >= 2 && (p[1] == 'o' || p[1] == 'O')) {
+      auto [ptr, ec] = std::from_chars(p + 2, q, v, 8);
+      if (ec == std::errc() && ptr == q) return neg ? -v : v;
+    } else if (*p == '0' && q - p >= 2 && (p[1] == 'b' || p[1] == 'B')) {
+      auto [ptr, ec] = std::from_chars(p + 2, q, v, 2);
+      if (ec == std::errc() && ptr == q) return neg ? -v : v;
+    } else if (!(*p == '0' && q - p > 1)) {  // leading-0 decimal: not full
+      auto [ptr, ec] = std::from_chars(p, q, v, 10);
+      if (ec == std::errc() && ptr == q) return neg ? -v : v;
+    }
+    // C strtoll(base 0) prefix fallback
+    v = 0;
+    if (*p == '0' && q - p >= 2 && (p[1] == 'x' || p[1] == 'X')) {
+      std::from_chars(p + 2, q, v, 16);
+    } else if (*p == '0' && q - p > 1) {
+      std::from_chars(p, q, v, 8);  // stops at first non-octal digit
+    } else {
+      std::from_chars(p, q, v, 10);
+    }
+    return neg ? -v : v;
+  }
+  return 0;
+}
+
+// -- tokenizing --------------------------------------------------------------
+
+struct Line {
+  const char* b;
+  const char* e;
+};
+
+// Iterate lines of [b,e) like Python bytes.splitlines (\n, \r, \r\n).
+template <typename F>
+void for_each_line(const char* b, const char* e, F&& fn) {
+  const char* p = b;
+  while (p < e) {
+    const char* le = p;
+    while (le < e && *le != '\n' && *le != '\r') ++le;
+    fn(Line{p, le});
+    if (le < e) {
+      if (*le == '\r' && le + 1 < e && le[1] == '\n') ++le;
+      ++le;
+    }
+    p = le;
+  }
+}
+
+template <typename F>
+void for_each_token(const char* b, const char* e, F&& fn) {
+  const char* p = b;
+  while (p < e) {
+    while (p < e && (is_blank(*p))) ++p;
+    if (p >= e) break;
+    const char* te = p;
+    while (te < e && !is_blank(*te)) ++te;
+    if (!fn(p, te)) return;
+    p = te;
+  }
+}
+
+}  // namespace
+
+// -- libsvm ------------------------------------------------------------------
+
+DMLC_API ParseResult* dmlc_parse_libsvm(const char* buf, int64_t len,
+                                          int32_t indexing_mode) {
+  Holder* h = new Holder();
+  // rough sizing: ~12 bytes per feature token, ~48 bytes per row
+  h->index.reserve(static_cast<size_t>(len / 12 + 8));
+  h->value.reserve(static_cast<size_t>(len / 12 + 8));
+  h->label.reserve(static_cast<size_t>(len / 48 + 8));
+  h->weight.reserve(static_cast<size_t>(len / 48 + 8));
+  h->qid.reserve(static_cast<size_t>(len / 48 + 8));
+  h->offset.reserve(static_cast<size_t>(len / 48 + 9));
+  h->offset.push_back(0);
+  bool any_weight = false, any_qid = false, any_value = false;
+  int64_t min_feat = INT64_MAX;
+  for_each_line(buf, buf + len, [&](Line ln) {
+    const char* lb = ln.b;
+    const char* le = ln.e;
+    const void* hash = memchr(lb, '#', static_cast<size_t>(le - lb));
+    if (hash) le = static_cast<const char*>(hash);
+    bool first = true;
+    bool row_open = false;
+    int tok_i = 0;
+    for_each_token(lb, le, [&](const char* tb, const char* te) {
+      if (first) {
+        first = false;
+        const char* colon =
+            static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
+        double lab, w = 1.0;
+        bool has_w = false;
+        if (colon) {
+          if (!parse_float_full(tb, colon, &lab) ||
+              !parse_float_full(colon + 1, te, &w))
+            return false;  // non-numeric label token: skip line
+          has_w = true;
+        } else if (!parse_float_full(tb, te, &lab)) {
+          return false;
+        }
+        h->label.push_back(static_cast<float>(lab));
+        h->weight.push_back(static_cast<float>(w));
+        h->qid.push_back(0);
+        if (has_w) any_weight = true;
+        row_open = true;
+        tok_i = 1;
+        return true;
+      }
+      if (tok_i == 1 && te - tb >= 4 && memcmp(tb, "qid:", 4) == 0) {
+        int64_t q = 0;
+        if (parse_i64_full(tb + 4, te, &q)) {
+          h->qid.back() = q;
+        }  // garbage qid -> 0, keep parsing (reference atoll)
+        any_qid = true;
+        tok_i = 2;
+        return true;
+      }
+      tok_i = 2;
+      const char* colon =
+          static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
+      int64_t feat;
+      if (colon) {
+        double v;
+        if (!parse_i64_full(tb, colon, &feat) ||
+            !parse_float_full(colon + 1, te, &v))
+          return true;  // malformed token: skip it
+        h->index.push_back(static_cast<uint64_t>(feat));
+        h->value.push_back(static_cast<float>(v));
+        any_value = true;
+      } else {
+        if (!parse_i64_full(tb, te, &feat)) return true;
+        h->index.push_back(static_cast<uint64_t>(feat));
+        h->value.push_back(1.0f);
+      }
+      if (feat < min_feat) min_feat = feat;
+      return true;
+    });
+    if (row_open) h->offset.push_back(static_cast<int64_t>(h->index.size()));
+  });
+  if (indexing_mode > 0 ||
+      (indexing_mode < 0 && !h->index.empty() && min_feat > 0)) {
+    for (auto& i : h->index) --i;
+  }
+  h->res.has_weight = any_weight ? 1 : 0;
+  h->res.has_qid = any_qid ? 1 : 0;
+  h->res.has_value = any_value ? 1 : 0;
+  h->res.has_field = 0;
+  return finish(h);
+}
+
+// -- csv ---------------------------------------------------------------------
+
+DMLC_API ParseResult* dmlc_parse_csv(const char* buf, int64_t len,
+                                       int32_t delimiter, int32_t label_column,
+                                       int32_t weight_column) {
+  Holder* h = new Holder();
+  h->offset.push_back(0);
+  bool any_weight = false;
+  const char delim = static_cast<char>(delimiter);
+  bool failed = false;
+  for_each_line(buf, buf + len, [&](Line ln) {
+    if (failed || ln.b == ln.e) return;
+    const char* p = ln.b;
+    int col = 0;
+    int64_t k = 0;
+    float lab = 0.0f;
+    float w = 1.0f;
+    bool saw_weight = false;
+    int ncells = 0;
+    while (p <= ln.e) {
+      const char* ce = static_cast<const char*>(
+          memchr(p, delim, static_cast<size_t>(ln.e - p)));
+      if (!ce) ce = ln.e;
+      ++ncells;
+      double v = parse_float_prefix(p, ce);
+      if (col == label_column) {
+        lab = static_cast<float>(v);
+      } else if (col == weight_column) {
+        w = static_cast<float>(v);
+        saw_weight = true;
+      } else {
+        h->value.push_back(static_cast<float>(v));
+        h->index.push_back(static_cast<uint64_t>(k++));
+      }
+      ++col;
+      if (ce == ln.e) break;
+      p = ce + 1;
+    }
+    (void)ncells;
+    if (k == 0) {
+      h->error_msg = "Delimiter not found in the line. Expected it to separate fields.";
+      failed = true;
+      return;
+    }
+    h->label.push_back(lab);
+    h->weight.push_back(w);
+    if (saw_weight) any_weight = true;
+    h->offset.push_back(static_cast<int64_t>(h->index.size()));
+  });
+  h->res.has_weight = any_weight ? 1 : 0;
+  h->res.has_value = 1;
+  h->res.has_qid = 0;
+  h->res.has_field = 0;
+  return finish(h);
+}
+
+// -- libfm -------------------------------------------------------------------
+
+DMLC_API ParseResult* dmlc_parse_libfm(const char* buf, int64_t len,
+                                         int32_t indexing_mode) {
+  Holder* h = new Holder();
+  h->offset.push_back(0);
+  bool any_weight = false, any_value = false;
+  int64_t min_feat = INT64_MAX, min_field = INT64_MAX;
+  for_each_line(buf, buf + len, [&](Line ln) {
+    bool first = true;
+    bool row_open = false;
+    for_each_token(ln.b, ln.e, [&](const char* tb, const char* te) {
+      if (first) {
+        first = false;
+        const char* colon =
+            static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
+        double lab, w = 1.0;
+        bool has_w = false;
+        if (colon) {
+          if (!parse_float_full(tb, colon, &lab) ||
+              !parse_float_full(colon + 1, te, &w))
+            return false;
+          has_w = true;
+        } else if (!parse_float_full(tb, te, &lab)) {
+          return false;
+        }
+        h->label.push_back(static_cast<float>(lab));
+        h->weight.push_back(static_cast<float>(w));
+        if (has_w) any_weight = true;
+        row_open = true;
+        return true;
+      }
+      const char* c1 =
+          static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
+      if (!c1) return true;  // fewer than two numbers: skip token
+      const char* c2 = static_cast<const char*>(
+          memchr(c1 + 1, ':', static_cast<size_t>(te - c1 - 1)));
+      int64_t fid, feat;
+      if (!parse_i64_full(tb, c1, &fid)) return true;
+      if (c2) {
+        double v;
+        if (!parse_i64_full(c1 + 1, c2, &feat) ||
+            !parse_float_full(c2 + 1, te, &v))
+          return true;
+        h->value.push_back(static_cast<float>(v));
+        any_value = true;
+      } else {
+        if (!parse_i64_full(c1 + 1, te, &feat)) return true;
+        h->value.push_back(1.0f);
+      }
+      h->field.push_back(fid);
+      h->index.push_back(static_cast<uint64_t>(feat));
+      if (feat < min_feat) min_feat = feat;
+      if (fid < min_field) min_field = fid;
+      return true;
+    });
+    if (row_open) h->offset.push_back(static_cast<int64_t>(h->index.size()));
+  });
+  if (indexing_mode > 0 || (indexing_mode < 0 && !h->index.empty() &&
+                            min_feat > 0 && min_field > 0)) {
+    for (auto& i : h->index) --i;
+    for (auto& f : h->field) --f;
+  }
+  h->res.has_weight = any_weight ? 1 : 0;
+  h->res.has_value = any_value ? 1 : 0;
+  h->res.has_field = 1;
+  h->res.has_qid = 0;
+  return finish(h);
+}
+
+DMLC_API void dmlc_free_result(ParseResult* r) {
+  delete reinterpret_cast<Holder*>(r);
+}

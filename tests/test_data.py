@@ -107,21 +107,6 @@ LIBSVM_TEXT = b"""1 0:1.5 3:2.5 # a comment
 """
 
 
-def parse_with(cls, text, args=None, **kw):
-    path = kw.pop("path")
-    with open(path, "wb") as f:
-        f.write(text)
-    src = D.create_parser(str(path), type=cls, threaded=False, **kw)
-    blocks = []
-    while True:
-        got = src.parse_next()
-        if got is None:
-            break
-        blocks.extend(b for b in got if b.size)
-    src.close()
-    return RowBlock.concat(blocks) if blocks else None
-
-
 def write_parse(tmp_path, name, text, fmt, args=""):
     path = tmp_path / name
     with open(path, "wb") as f:

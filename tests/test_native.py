@@ -1,0 +1,164 @@
+"""Native C++ parse core: availability + exact parity with the Python
+fallbacks (the semantic contract stated in native/fastparse.cc)."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import native
+from dmlc_core_tpu.data.csv_parser import CSVParser
+from dmlc_core_tpu.data.libfm_parser import LibFMParser
+from dmlc_core_tpu.data.libsvm_parser import LibSVMParser
+from dmlc_core_tpu.io.split import LineSplitter
+
+pytestmark = pytest.mark.skipif(
+    not native.load(), reason="native library not built"
+)
+
+
+def make_parser(cls, tmp_path, args=None):
+    p = tmp_path / "stub.txt"
+    p.write_text("0 0:0\n" if cls is not CSVParser else "0\n")
+    src = LineSplitter(str(p), 0, 1)
+    return cls(src, args or {}, nthread=1)
+
+
+def both_ways(parser, data: bytes):
+    native_blk = parser.parse_block(data)
+    py_blk = parser._parse_block_py(data)
+    return native_blk, py_blk
+
+
+def assert_blocks_equal(a, b):
+    np.testing.assert_array_equal(a.offset, b.offset)
+    np.testing.assert_allclose(a.label, b.label, rtol=1e-6)
+    np.testing.assert_array_equal(a.index, b.index)
+    for name in ("value", "weight"):
+        av, bv = getattr(a, name), getattr(b, name)
+        assert (av is None) == (bv is None), f"{name} presence differs"
+        if av is not None:
+            np.testing.assert_allclose(av, bv, rtol=1e-6)
+    for name in ("qid", "field"):
+        av, bv = getattr(a, name), getattr(b, name)
+        assert (av is None) == (bv is None), f"{name} presence differs"
+        if av is not None:
+            np.testing.assert_array_equal(av, bv)
+
+
+LIBSVM_CASES = [
+    b"",
+    b"1 0:1.5 3:2.5\n-1 1:0.5\n",
+    b"1 0:1.5 3:2.5 # comment\n# full comment\n\n0.5:2.0 qid:7 2:1.0\n",
+    b"1 3 5 9\n0 2 4\n",                      # binary features
+    b"1 1:0.5 3:2\n0 2:1\n",                  # ints as values
+    b"1 qid:abc 1:0.5\n",                     # malformed qid
+    b"1 qid: 1:0.5\n",                        # empty qid
+    b"abc 1:0.5\n1 0:2.0\n",                  # non-numeric label line skipped
+    b"1 x:0.5 2:bad 3:1.0\n",                 # malformed feature tokens
+    b"1 0:1e-3 2:1E4 3:-2.5e+2\n",            # exponents
+    b"1:0.25 0:1\n",                          # weighted, no qid
+    b"1 0:inf 1:nan\n",                       # special floats
+    b"NA 1:1\n2 2:2",                          # NOEOL last line
+    b"1 0:1.5\r\n2 1:2.5\r0 2:0.5\n",         # CR / CRLF
+]
+
+
+@pytest.mark.parametrize("case", range(len(LIBSVM_CASES)))
+@pytest.mark.parametrize("mode", [0, 1, -1])
+def test_libsvm_parity(tmp_path, case, mode):
+    parser = make_parser(LibSVMParser, tmp_path, {"indexing_mode": mode})
+    a, b = both_ways(parser, LIBSVM_CASES[case])
+    assert_blocks_equal(a, b)
+
+
+CSV_CASES = [
+    b"",
+    b"1.0,2.0,3.0\n4.0,5.0,6.0\n",
+    b"1.0,,3.0\n",                # empty cell -> 0
+    b"1,abc,3\n",                 # junk cell -> 0
+    b"7.0,1.0,0.25\n",
+    b"1\n2\n3\n",                 # single column
+    b"1.5e3,2E-2\n",
+    b"-1.0,+2.0\n",
+    b"9,8,7",                     # NOEOL
+]
+
+
+@pytest.mark.parametrize("case", range(len(CSV_CASES)))
+@pytest.mark.parametrize(
+    "args",
+    [{}, {"label_column": 0}, {"label_column": 0, "weight_column": 2}],
+)
+def test_csv_parity(tmp_path, case, args):
+    parser = make_parser(CSVParser, tmp_path, args)
+    data = CSV_CASES[case]
+    if data == b"1\n2\n3\n" and args.get("label_column") == 0:
+        return  # single column entirely consumed by the label: no feature
+    a, b = both_ways(parser, data)
+    assert_blocks_equal(a, b)
+
+
+def test_csv_error_parity(tmp_path):
+    # the lone cell is consumed by the label -> no feature -> error
+    parser = make_parser(CSVParser, tmp_path, {"label_column": 0})
+    with pytest.raises(Exception, match="Delimiter"):
+        parser._parse_block_py(b"1\n")
+    with pytest.raises(Exception, match="Delimiter"):
+        parser.parse_block(b"1\n")
+
+
+LIBFM_CASES = [
+    b"",
+    b"1 0:3:1.5 2:7:0.5\n-1:0.5 1:4:2.0\n",
+    b"1 1:1:0.5 2:3:0.5\n",
+    b"1 0:3 2:7\n",               # field:index without value
+    b"1 junk 0:3:1.5 5\n",        # malformed tokens skipped
+    b"x 0:3:1.5\n1 1:1:1\n",      # bad label line skipped
+]
+
+
+@pytest.mark.parametrize("case", range(len(LIBFM_CASES)))
+@pytest.mark.parametrize("mode", [0, 1, -1])
+def test_libfm_parity(tmp_path, case, mode):
+    parser = make_parser(LibFMParser, tmp_path, {"indexing_mode": mode})
+    a, b = both_ways(parser, LIBFM_CASES[case])
+    assert_blocks_equal(a, b)
+
+
+def test_fuzz_parity(tmp_path):
+    """Randomized libsvm blocks parse identically both ways."""
+    rng = np.random.default_rng(7)
+    parser = make_parser(LibSVMParser, tmp_path, {"indexing_mode": -1})
+    for trial in range(20):
+        lines = []
+        for _ in range(50):
+            n = rng.integers(0, 8)
+            feats = " ".join(
+                f"{int(j)}:{rng.normal():.6g}"
+                for j in sorted(rng.integers(0, 1000, n))
+            )
+            label = f"{rng.normal():.4g}"
+            if rng.random() < 0.3:
+                label += f":{abs(rng.normal()):.3g}"
+            if rng.random() < 0.3:
+                feats = f"qid:{rng.integers(0, 99)} " + feats
+            lines.append(f"{label} {feats}\n")
+        data = "".join(lines).encode()
+        a, b = both_ways(parser, data)
+        assert_blocks_equal(a, b)
+
+
+def test_no_native_fallback_env(tmp_path):
+    """DMLC_TPU_NO_NATIVE=1 disables the fast path cleanly."""
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from dmlc_core_tpu.data import native; "
+        "assert not native.AVAILABLE" % "/root/repo"
+    )
+    subprocess.run(
+        [sys.executable, "-c", code],
+        check=True,
+        env={"DMLC_TPU_NO_NATIVE": "1", "PATH": "/usr/bin:/bin"},
+    )
