@@ -1,0 +1,124 @@
+"""Benchmark: HIGGS-like libsvm → parse → fixed-shape batches → TPU HBM.
+
+Measures the north-star metric (BASELINE.md): parsed rows/sec staged into
+device memory, end to end (sharded read → native parse fan-out → batcher →
+async device_put). Prints ONE JSON line:
+
+    {"metric": "higgs_staged_rows_per_sec", "value": N,
+     "unit": "rows/sec", "vs_baseline": N / 1_000_000}
+
+vs_baseline is against the 1M rows/sec target (the reference publishes no
+numbers of its own — SURVEY §6).
+
+Run on the TPU host as-is (default jax device). Synthetic data is cached
+under /tmp between runs. Use BENCH_ROWS / BENCH_EPOCHS to resize.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", "400000"))
+N_FEATURES = 28  # HIGGS
+EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
+BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+DATA = os.environ.get(
+    "BENCH_DATA", f"/tmp/dmlc_tpu_bench_higgs_{N_ROWS}.libsvm"
+)
+
+
+def ensure_native() -> None:
+    so = os.path.join(REPO, "native", "libdmlc_tpu_native.so")
+    if not os.path.exists(so):
+        subprocess.run(
+            ["make", "-C", os.path.join(REPO, "native")],
+            check=False,
+            capture_output=True,
+        )
+
+
+def ensure_data() -> None:
+    if os.path.exists(DATA) and os.path.getsize(DATA) > 0:
+        return
+    rng = np.random.default_rng(42)
+    tmp = DATA + ".tmp"
+    with open(tmp, "w") as f:
+        chunk = 10000
+        for start in range(0, N_ROWS, chunk):
+            n = min(chunk, N_ROWS - start)
+            vals = rng.normal(size=(n, N_FEATURES))
+            labels = rng.integers(0, 2, n)
+            lines = []
+            for i in range(n):
+                feats = " ".join(
+                    f"{j}:{vals[i, j]:.7f}" for j in range(N_FEATURES)
+                )
+                lines.append(f"{labels[i]} {feats}\n")
+            f.write("".join(lines))
+    os.replace(tmp, DATA)
+
+
+def run_epoch() -> dict:
+    import jax
+
+    from dmlc_core_tpu import data as D
+    from dmlc_core_tpu.staging import BatchSpec, FixedShapeBatcher, StagingPipeline
+
+    nthread = min(16, os.cpu_count() or 1)
+    parser = D.create_parser(DATA, type="libsvm", nthread=nthread)
+    spec = BatchSpec(
+        batch_size=BATCH, layout="dense", num_features=N_FEATURES + 1
+    )
+    batcher = FixedShapeBatcher(spec)
+    pipe = StagingPipeline(batcher.batches(iter(parser)), depth=2)
+    t0 = time.perf_counter()
+    last = None
+    rows = 0
+    for dev in pipe:
+        last = dev
+        rows += int(dev["x"].shape[0])
+    if last is not None:
+        jax.block_until_ready(last["x"])
+    dt = time.perf_counter() - t0
+    parser.close()
+    pipe.close()
+    return {
+        "rows": pipe.rows_staged,
+        "secs": dt,
+        "rows_per_sec": pipe.rows_staged / dt,
+        "device": str(jax.devices()[0]),
+    }
+
+
+def main() -> None:
+    ensure_native()
+    ensure_data()
+    best = None
+    for _ in range(EPOCHS):
+        stats = run_epoch()
+        if best is None or stats["rows_per_sec"] > best["rows_per_sec"]:
+            best = stats
+    value = round(best["rows_per_sec"], 1)
+    print(
+        json.dumps(
+            {
+                "metric": "higgs_staged_rows_per_sec",
+                "value": value,
+                "unit": "rows/sec",
+                "vs_baseline": round(value / 1_000_000, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

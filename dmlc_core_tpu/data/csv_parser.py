@@ -33,29 +33,42 @@ _FLOAT_PREFIX = re.compile(
 _INT_PREFIX = re.compile(rb"([+-]?)(0[xX][0-9a-fA-F]+|[0-9]+)")
 
 
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
 def _parse_cell(cell: bytes, is_float: bool):
     """C strtof/strtoll(base 0) prefix semantics (reference
-    csv_parser.h:98-106): parse the longest numeric prefix, 0 if none."""
+    csv_parser.h:98-106): parse the longest numeric prefix, 0 if none.
+    PEP-515 underscores are never accepted (C grammar); int overflow
+    clamps like strtoll."""
     if is_float:
+        if b"_" not in cell:
+            try:
+                return float(cell)
+            except ValueError:
+                pass
+        m = _FLOAT_PREFIX.match(cell.strip())
+        return float(m.group(0)) if m else 0.0
+    if b"_" not in cell:
         try:
-            return float(cell)
+            return _clamp_i64(int(cell, 0))
         except ValueError:
-            m = _FLOAT_PREFIX.match(cell.strip())
-            return float(m.group(0)) if m else 0.0
-    try:
-        return int(cell, 0)
-    except ValueError:
-        m = _INT_PREFIX.match(cell.strip())
-        if not m:
-            return 0
-        sign, digits = m.group(1), m.group(2)
-        if digits[:2].lower() == b"0x":
-            val = int(digits, 16)
-        elif digits.startswith(b"0") and len(digits) > 1:
-            val = int(re.match(rb"0[0-7]*", digits).group(0), 8)
-        else:
-            val = int(digits)
-        return -val if sign == b"-" else val
+            pass
+    m = _INT_PREFIX.match(cell.strip())
+    if not m:
+        return 0
+    sign, digits = m.group(1), m.group(2)
+    if digits[:2].lower() == b"0x":
+        val = int(digits, 16)
+    elif digits.startswith(b"0") and len(digits) > 1:
+        val = int(re.match(rb"0[0-7]*", digits).group(0), 8)
+    else:
+        val = int(digits)
+    return _clamp_i64(-val if sign == b"-" else val)
+
+
+def _clamp_i64(v: int) -> int:
+    return min(max(v, _I64_MIN), _I64_MAX)
 
 
 class CSVParserParam(Parameter):

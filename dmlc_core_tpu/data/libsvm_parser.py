@@ -29,7 +29,7 @@ from ..params.parameter import Parameter, field
 from ..utils.logging import check, check_eq
 from . import native
 from .row_block import INDEX_T, REAL_T, RowBlock
-from .strtonum import parse_pair
+from .strtonum import parse_float_token, parse_int_token, parse_pair
 from .text_parser import TextParserBase
 
 __all__ = ["LibSVMParser", "LibSVMParserParam"]
@@ -104,21 +104,21 @@ class LibSVMParser(TextParserBase):
             start = 1
             qid = None
             if len(toks) > 1 and toks[1].startswith(b"qid:"):
-                try:
-                    qid = int(toks[1][4:])
-                except ValueError:
-                    qid = 0  # reference atoll on garbage -> 0, keep parsing
+                # garbage/overflow qid -> 0, keep parsing (reference atoll)
+                qid = parse_int_token(toks[1][4:]) or 0
                 start = 2
             row_vals = []
             for t in toks[start:]:
                 c = t.find(b":")
-                try:
-                    if c < 0:
-                        feat, val = int(t), None
-                    else:
-                        feat, val = int(t[:c]), float(t[c + 1:])
-                except ValueError:
-                    continue  # non-numeric token: reference ParsePair r<1 skip
+                if c < 0:
+                    feat, val = parse_int_token(t), None
+                else:
+                    feat = parse_int_token(t[:c])
+                    val = parse_float_token(t[c + 1:])
+                    if val is None:
+                        feat = None
+                if feat is None:
+                    continue  # malformed token: reference ParsePair r<1 skip
                 index.append(feat)
                 row_vals.append(val)
             if any(v is not None for v in row_vals):

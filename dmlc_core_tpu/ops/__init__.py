@@ -1,0 +1,13 @@
+"""Compute ops over staged batches (jax/XLA; pallas variants can slot in).
+
+The reference's only compute is ``Row::SDot`` (include/dmlc/data.h:137-152)
+— the sparse dot its downstream learners run. Here that becomes batched,
+fixed-shape ops XLA can fuse and tile:
+
+- dense layout → plain ``x @ w`` (MXU path)
+- ell layout → vectorized gather-multiply-reduce (VPU path)
+"""
+
+from .sparse import ell_matvec, ell_matmul, ell_to_dense, weighted_mean
+
+__all__ = ["ell_matvec", "ell_matmul", "ell_to_dense", "weighted_mean"]

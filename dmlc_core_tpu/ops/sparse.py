@@ -1,0 +1,52 @@
+"""Sparse ops on ELL (capped-CSR) batches.
+
+Batched generalization of the reference's Row::SDot (data.h:137-152): the
+scalar per-row loop becomes one gather + elementwise multiply + reduction
+over the fixed K dimension, which XLA fuses into a single kernel. Padding
+slots carry value 0.0, so no masking is needed in the reduction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ell_matvec", "ell_matmul", "ell_to_dense", "weighted_mean"]
+
+
+def ell_matvec(indices: jax.Array, values: jax.Array, w: jax.Array) -> jax.Array:
+    """Per-row sparse dot with a dense vector.
+
+    indices: i32[B, K]; values: f32[B, K]; w: f32[D] → f32[B].
+    Batched Row::SDot: out[b] = Σ_k values[b,k] * w[indices[b,k]].
+    """
+    return jnp.sum(values * jnp.take(w, indices, axis=0), axis=-1)
+
+
+def ell_matmul(indices: jax.Array, values: jax.Array, table: jax.Array) -> jax.Array:
+    """Sparse-dense matmul against an embedding/weight table.
+
+    indices: i32[B, K]; values: f32[B, K]; table: f32[D, E] → f32[B, E]:
+    out[b] = Σ_k values[b,k] * table[indices[b,k], :] — the FM/embedding
+    gather path.
+    """
+    gathered = jnp.take(table, indices, axis=0)  # [B, K, E]
+    return jnp.einsum("bk,bke->be", values, gathered)
+
+
+def ell_to_dense(
+    indices: jax.Array, values: jax.Array, num_features: int
+) -> jax.Array:
+    """ELL → dense f32[B, D] (duplicates accumulate, matching the host-side
+    dense batcher). Use when D is small enough that the MXU matmul beats
+    the gather."""
+    b = indices.shape[0]
+    rows = jnp.repeat(jnp.arange(b), indices.shape[1])
+    dense = jnp.zeros((b, num_features), dtype=values.dtype)
+    return dense.at[rows, indices.reshape(-1)].add(values.reshape(-1))
+
+
+def weighted_mean(per_row: jax.Array, weights: jax.Array) -> jax.Array:
+    """Weight-masked mean: padding rows (weight 0) contribute nothing."""
+    total = jnp.sum(weights)
+    return jnp.sum(per_row * weights) / jnp.maximum(total, 1e-9)

@@ -1,0 +1,25 @@
+"""SPMD parallelism over jax device meshes.
+
+The TPU-native replacement for the reference's distributed plumbing
+(SURVEY §2.9/§5.8): the tracker's tree+ring topology becomes "read the
+mesh" — XLA emits the collectives; ranks come from jax.process_index().
+
+- mesh helpers: build 1-D/2-D meshes ('data' [+ 'model'] axes)
+- data_parallel_step: jit a step fn with batch sharded on 'data' and
+  params replicated (or sharded by rules → tensor parallelism); XLA
+  inserts the gradient psum that rabit's allreduce performed downstream
+- process_shard(): the (part_index, num_parts) pair for InputSplit, bound
+  to the process mesh so every host reads a disjoint record-aligned slice
+  (the reference's only training parallelism, io.h:261-301)
+"""
+
+from .mesh import make_mesh, process_shard
+from .spmd import data_parallel_step, replicate, shard_params
+
+__all__ = [
+    "make_mesh",
+    "process_shard",
+    "data_parallel_step",
+    "replicate",
+    "shard_params",
+]

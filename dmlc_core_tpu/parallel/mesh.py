@@ -1,0 +1,63 @@
+"""Device mesh construction + process-rank plumbing.
+
+Replaces the reference tracker's hand-computed tree/ring maps
+(tracker.py:165-252): on TPU the interconnect topology is the ICI mesh
+libtpu already knows, so "topology computation" is just arranging
+jax.devices() into a named Mesh and letting XLA route collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import check
+
+__all__ = ["make_mesh", "process_shard"]
+
+
+def make_mesh(
+    shape: Optional[Sequence[int]] = None,
+    axis_names: Sequence[str] = ("data",),
+    devices=None,
+    backend: Optional[str] = None,
+):
+    """Build a jax.sharding.Mesh.
+
+    - default: all devices on one 'data' axis
+    - shape (d0, d1, ...) with matching axis_names for n-D meshes, e.g.
+      ((4, 2), ('data', 'model')). Use -1 in at most one slot to absorb
+      the remaining devices.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices(backend) if backend else jax.devices()
+    devices = np.asarray(devices)
+    n = devices.size
+    if shape is None:
+        shape = (n,)
+    shape = list(shape)
+    check(len(shape) == len(axis_names), "shape/axis_names length mismatch")
+    if -1 in shape:
+        i = shape.index(-1)
+        rest = int(np.prod([s for s in shape if s != -1]))
+        check(n % rest == 0, f"{n} devices not divisible by {rest}")
+        shape[i] = n // rest
+    check(
+        int(np.prod(shape)) == n,
+        f"mesh shape {tuple(shape)} != {n} devices",
+    )
+    return Mesh(devices.reshape(shape), tuple(axis_names))
+
+
+def process_shard() -> Tuple[int, int]:
+    """(part_index, num_parts) for InputSplit/parsers, bound to the
+    process mesh: every host reads a disjoint slice (data parallelism as
+    in reference io.h:261-301, rank from the env contract superseded by
+    jax.distributed)."""
+    import jax
+
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,17 @@
+"""TPU staging layer: fixed-shape batching + double-buffered HBM transfer.
+
+The genuinely new TPU-native component (no reference analogue; SURVEY §7
+steps 4-5): ragged RowBlocks → static-shape batches → async device_put with
+bounded in-flight depth, optionally sharded over a jax Mesh data axis.
+"""
+
+from .batcher import Batch, BatchSpec, FixedShapeBatcher
+from .pipeline import StagingPipeline, stage_batch
+
+__all__ = [
+    "Batch",
+    "BatchSpec",
+    "FixedShapeBatcher",
+    "StagingPipeline",
+    "stage_batch",
+]

@@ -1,0 +1,229 @@
+"""Fixed-shape batching of ragged RowBlocks — the TPU-specific reshaping.
+
+No reference analogue (SURVEY §7 hard part 1): the reference feeds ragged
+CSR RowBlocks to a CPU learner; XLA needs static shapes. This module turns a
+stream of RowBlocks into fixed-shape numpy batches ready for device_put:
+
+- ``ell`` layout: capped-CSR / ELL — ``indices i32[B,K]``, ``values
+  f32[B,K]`` with zero-padding and per-row ``nnz`` counts. ``K`` =
+  max nnz per row; overflow policy 'truncate' (drop extra features,
+  counted in stats) or 'error'.
+- ``dense`` layout: scatter into ``x f32[B,D]`` — right for dense-ish data
+  (HIGGS: 28 features) and the MXU, which wants large dense matmuls.
+
+Partial final batches are zero-padded to exactly B rows with weight 0, so
+every batch compiles to the same XLA program; models must use ``weights``
+as the validity mask (padding rows contribute zero loss).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+from ..data.row_block import RowBlock
+from ..utils.logging import Error, check
+
+__all__ = ["Batch", "BatchSpec", "FixedShapeBatcher"]
+
+
+@dataclass(frozen=True)
+class Batch:
+    """One fixed-shape host batch. Arrays are numpy, ready for device_put.
+
+    ``n_valid`` rows are real; rows beyond that are zero padding with
+    weight 0. For 'ell': indices/values are [B,K]; for 'dense': x is [B,D].
+    """
+
+    labels: np.ndarray
+    weights: np.ndarray
+    n_valid: int
+    indices: Optional[np.ndarray] = None
+    values: Optional[np.ndarray] = None
+    nnz: Optional[np.ndarray] = None
+    x: Optional[np.ndarray] = None
+
+    @property
+    def batch_size(self) -> int:
+        return len(self.labels)
+
+    def as_dict(self) -> Dict[str, np.ndarray]:
+        """Pytree-friendly dict (what lands on device)."""
+        out = {"labels": self.labels, "weights": self.weights}
+        if self.x is not None:
+            out["x"] = self.x
+        else:
+            out["indices"] = self.indices
+            out["values"] = self.values
+            out["nnz"] = self.nnz
+        return out
+
+
+@dataclass
+class BatchSpec:
+    """Static-shape contract for a batch stream.
+
+    batch_size: rows per batch (pick a multiple of the mesh's data-parallel
+    size × 8 so per-device shards stay MXU/VPU friendly).
+    layout: 'ell' or 'dense'.
+    max_nnz: K for 'ell' (required there).
+    num_features: D for 'dense' (required there); indices >= D follow
+    ``overflow`` policy.
+    overflow: 'truncate' | 'error'.
+    """
+
+    batch_size: int
+    layout: str = "ell"
+    max_nnz: Optional[int] = None
+    num_features: Optional[int] = None
+    overflow: str = "truncate"
+    index_dtype: np.dtype = np.dtype(np.int32)
+
+    def __post_init__(self) -> None:
+        check(self.layout in ("ell", "dense"), f"bad layout {self.layout!r}")
+        check(self.overflow in ("truncate", "error"),
+              f"bad overflow policy {self.overflow!r}")
+        if self.layout == "ell":
+            check(self.max_nnz is not None and self.max_nnz > 0,
+                  "ell layout requires max_nnz")
+        else:
+            check(self.num_features is not None and self.num_features > 0,
+                  "dense layout requires num_features")
+
+
+class FixedShapeBatcher:
+    """RowBlock stream → fixed-shape Batch stream (all-numpy, vectorized).
+
+    Carries a partial-row remainder between input blocks so batches are
+    exactly ``batch_size`` rows; the final batch is zero-padded.
+    """
+
+    def __init__(self, spec: BatchSpec) -> None:
+        self.spec = spec
+        self.rows_in = 0
+        self.rows_out = 0
+        self.truncated_nnz = 0
+        self._pending: list[RowBlock] = []
+        self._pending_rows = 0
+
+    # -- conversion cores ----------------------------------------------------
+    def _to_ell(self, blk: RowBlock, n_valid: int) -> Batch:
+        spec = self.spec
+        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
+        nnz_per_row = np.diff(blk.offset)
+        over = nnz_per_row - K
+        n_over = int(over[over > 0].sum()) if len(over) else 0
+        if n_over:
+            if spec.overflow == "error":
+                raise Error(
+                    f"row nnz exceeds max_nnz={K} "
+                    f"(worst row has {int(nnz_per_row.max())})"
+                )
+            self.truncated_nnz += n_over
+        indices = np.zeros((B, K), dtype=spec.index_dtype)
+        values = np.zeros((B, K), dtype=np.float32)
+        m = len(nnz_per_row)
+        if blk.nnz:
+            row_ids = np.repeat(np.arange(m), nnz_per_row)
+            pos = np.arange(blk.nnz) - np.repeat(blk.offset[:-1], nnz_per_row)
+            keep = pos < K
+            r, p = row_ids[keep], pos[keep]
+            indices[r, p] = blk.index[keep].astype(spec.index_dtype)
+            vals = (
+                blk.value[keep]
+                if blk.value is not None
+                else np.ones(int(keep.sum()), dtype=np.float32)
+            )
+            values[r, p] = vals
+        nnz = np.zeros(B, dtype=np.int32)
+        nnz[:m] = np.minimum(nnz_per_row, K)
+        labels = np.zeros(B, dtype=np.float32)
+        labels[:m] = blk.label
+        weights = np.zeros(B, dtype=np.float32)
+        weights[:m] = 1.0 if blk.weight is None else blk.weight
+        return Batch(
+            labels=labels, weights=weights, n_valid=n_valid,
+            indices=indices, values=values, nnz=nnz,
+        )
+
+    def _to_dense(self, blk: RowBlock, n_valid: int) -> Batch:
+        spec = self.spec
+        B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
+        x = np.zeros((B, D), dtype=np.float32)
+        m = blk.size
+        if blk.nnz:
+            nnz_per_row = np.diff(blk.offset)
+            row_ids = np.repeat(np.arange(m), nnz_per_row)
+            idx = blk.index.astype(np.int64)
+            keep = idx < D
+            n_over = int((~keep).sum())
+            if n_over:
+                if spec.overflow == "error":
+                    raise Error(
+                        f"feature index {int(idx.max())} >= num_features={D}"
+                    )
+                self.truncated_nnz += n_over
+            vals = (
+                blk.value
+                if blk.value is not None
+                else np.ones(blk.nnz, dtype=np.float32)
+            )
+            # duplicate indices within a row accumulate, matching sparse
+            # dot semantics
+            np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
+        labels = np.zeros(B, dtype=np.float32)
+        labels[:m] = blk.label
+        weights = np.zeros(B, dtype=np.float32)
+        weights[:m] = 1.0 if blk.weight is None else blk.weight
+        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x)
+
+    def _emit(self, blk: RowBlock) -> Batch:
+        n_valid = blk.size
+        self.rows_out += n_valid
+        if self.spec.layout == "ell":
+            return self._to_ell(blk, n_valid)
+        return self._to_dense(blk, n_valid)
+
+    # -- streaming -----------------------------------------------------------
+    def push(self, blk: RowBlock) -> Iterator[Batch]:
+        """Feed one RowBlock; yields zero or more full batches."""
+        if blk.size == 0:
+            return
+        self.rows_in += blk.size
+        self._pending.append(blk)
+        self._pending_rows += blk.size
+        B = self.spec.batch_size
+        while self._pending_rows >= B:
+            merged = (
+                self._pending[0]
+                if len(self._pending) == 1
+                else RowBlock.concat(self._pending)
+            )
+            head = merged.slice(0, B)
+            rest_rows = merged.size - B
+            self._pending = [merged.slice(B, merged.size)] if rest_rows else []
+            self._pending_rows = rest_rows
+            yield self._emit(head)
+
+    def flush(self) -> Optional[Batch]:
+        """Emit the final zero-padded partial batch, if any."""
+        if not self._pending_rows:
+            return None
+        merged = (
+            self._pending[0]
+            if len(self._pending) == 1
+            else RowBlock.concat(self._pending)
+        )
+        self._pending = []
+        self._pending_rows = 0
+        return self._emit(merged)
+
+    def batches(self, blocks: Iterator[RowBlock]) -> Iterator[Batch]:
+        """Convenience: full stream → batches, flushing at the end."""
+        for blk in blocks:
+            yield from self.push(blk)
+        tail = self.flush()
+        if tail is not None:
+            yield tail

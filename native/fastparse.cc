@@ -14,6 +14,7 @@
 #include <charconv>
 #include <cctype>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -70,7 +71,11 @@ ParseResult* finish(Holder* h) {
   return &r;
 }
 
-inline bool is_blank(char c) { return c == ' ' || c == '\t' || c == '\r'; }
+// matches Python bytes.split() whitespace (minus \n, which is a line
+// terminator here): space, tab, CR, vertical tab, form feed
+inline bool is_blank(char c) {
+  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+}
 
 // -- number parsing ----------------------------------------------------------
 
@@ -117,6 +122,8 @@ inline bool parse_float_simple(const char* b, const char* e, double* out) {
 }
 
 // Full-token float parse (Python float() semantics: whole token or fail).
+// Out-of-range magnitudes resolve via strtod (±inf on overflow, 0 on
+// underflow), matching Python float("1e999") == inf.
 inline bool parse_float_full(const char* b, const char* e, double* out) {
   while (b != e && is_blank(*b)) ++b;
   while (e != b && is_blank(*(e - 1))) --e;
@@ -124,6 +131,11 @@ inline bool parse_float_full(const char* b, const char* e, double* out) {
   b = skip_plus(b, e);
   if (b == e) return false;
   auto [ptr, ec] = std::from_chars(b, e, *out);
+  if (ec == std::errc::result_out_of_range && ptr == e) {
+    std::string tmp(b, e);
+    *out = std::strtod(tmp.c_str(), nullptr);
+    return true;
+  }
   return ec == std::errc() && ptr == e;
 }
 
@@ -134,6 +146,10 @@ inline double parse_float_prefix(const char* b, const char* e) {
   double v = 0.0;
   auto [ptr, ec] = std::from_chars(b, e, v);
   (void)ptr;
+  if (ec == std::errc::result_out_of_range) {
+    std::string tmp(b, e);
+    return std::strtod(tmp.c_str(), nullptr);
+  }
   return ec == std::errc() ? v : 0.0;
 }
 
@@ -145,47 +161,6 @@ inline bool parse_i64_full(const char* b, const char* e, int64_t* out) {
   if (b == e) return false;
   auto [ptr, ec] = std::from_chars(b, e, *out, 10);
   return ec == std::errc() && ptr == e;
-}
-
-// Python int(cell, 0): full token, prefixes 0x/0o/0b, leading-0 decimal
-// rejected. Fallback to C strtoll(base 0) prefix semantics on failure
-// (hex 0x, octal leading-0, else decimal; 0 when nothing parses). This is
-// the pair of attempts the Python CSV fallback makes (_parse_cell).
-inline int64_t parse_int_cell(const char* b, const char* e) {
-  const char* p = b;
-  while (p != e && is_blank(*p)) ++p;
-  const char* q = e;
-  while (q != p && is_blank(*(q - 1))) --q;
-  bool neg = false;
-  if (p != q && (*p == '+' || *p == '-')) neg = (*p++ == '-');
-  int64_t v = 0;
-  if (p != q) {
-    // try Python-style full parse first
-    if (*p == '0' && q - p >= 2 && (p[1] == 'x' || p[1] == 'X')) {
-      auto [ptr, ec] = std::from_chars(p + 2, q, v, 16);
-      if (ec == std::errc() && ptr == q) return neg ? -v : v;
-    } else if (*p == '0' && q - p >= 2 && (p[1] == 'o' || p[1] == 'O')) {
-      auto [ptr, ec] = std::from_chars(p + 2, q, v, 8);
-      if (ec == std::errc() && ptr == q) return neg ? -v : v;
-    } else if (*p == '0' && q - p >= 2 && (p[1] == 'b' || p[1] == 'B')) {
-      auto [ptr, ec] = std::from_chars(p + 2, q, v, 2);
-      if (ec == std::errc() && ptr == q) return neg ? -v : v;
-    } else if (!(*p == '0' && q - p > 1)) {  // leading-0 decimal: not full
-      auto [ptr, ec] = std::from_chars(p, q, v, 10);
-      if (ec == std::errc() && ptr == q) return neg ? -v : v;
-    }
-    // C strtoll(base 0) prefix fallback
-    v = 0;
-    if (*p == '0' && q - p >= 2 && (p[1] == 'x' || p[1] == 'X')) {
-      std::from_chars(p + 2, q, v, 16);
-    } else if (*p == '0' && q - p > 1) {
-      std::from_chars(p, q, v, 8);  // stops at first non-octal digit
-    } else {
-      std::from_chars(p, q, v, 10);
-    }
-    return neg ? -v : v;
-  }
-  return 0;
 }
 
 // -- tokenizing --------------------------------------------------------------
@@ -332,12 +307,10 @@ DMLC_API ParseResult* dmlc_parse_csv(const char* buf, int64_t len,
     float lab = 0.0f;
     float w = 1.0f;
     bool saw_weight = false;
-    int ncells = 0;
     while (p <= ln.e) {
       const char* ce = static_cast<const char*>(
           memchr(p, delim, static_cast<size_t>(ln.e - p)));
       if (!ce) ce = ln.e;
-      ++ncells;
       double v = parse_float_prefix(p, ce);
       if (col == label_column) {
         lab = static_cast<float>(v);
@@ -352,7 +325,6 @@ DMLC_API ParseResult* dmlc_parse_csv(const char* buf, int64_t len,
       if (ce == ln.e) break;
       p = ce + 1;
     }
-    (void)ncells;
     if (k == 0) {
       h->error_msg = "Delimiter not found in the line. Expected it to separate fields.";
       failed = true;

@@ -16,3 +16,13 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU plugin in this image force-registers itself and wins over
+# JAX_PLATFORMS env alone; the config update below reliably pins the test
+# session to the virtual 8-device CPU backend.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # data-layer-only environments
+    pass

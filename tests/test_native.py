@@ -62,6 +62,11 @@ LIBSVM_CASES = [
     b"1 0:inf 1:nan\n",                       # special floats
     b"NA 1:1\n2 2:2",                          # NOEOL last line
     b"1 0:1.5\r\n2 1:2.5\r0 2:0.5\n",         # CR / CRLF
+    b"1 0:1\x0b2:3\n1\x0c0:1\n",               # \v \f are separators
+    b"1 99999999999999999999:1 1:2\n",       # index > int64: token skipped
+    b"1 0:1_0 2:3\n1_0 0:1\n",               # PEP-515 underscores rejected
+    b"1 0:1e999 1:1e-999\n",                  # float over/underflow
+    b"1 qid:99999999999999999999 0:1\n",      # qid overflow -> 0
 ]
 
 
@@ -83,6 +88,8 @@ CSV_CASES = [
     b"1.5e3,2E-2\n",
     b"-1.0,+2.0\n",
     b"9,8,7",                     # NOEOL
+    b"1_0,2\n",                   # underscores: prefix parse
+    b"1e999,2\n",                 # overflow -> inf
 ]
 
 
@@ -152,10 +159,13 @@ def test_fuzz_parity(tmp_path):
 
 def test_no_native_fallback_env(tmp_path):
     """DMLC_TPU_NO_NATIVE=1 disables the fast path cleanly."""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     code = (
         "import sys; sys.path.insert(0, %r); "
         "from dmlc_core_tpu.data import native; "
-        "assert not native.AVAILABLE" % "/root/repo"
+        "assert not native.AVAILABLE" % repo
     )
     subprocess.run(
         [sys.executable, "-c", code],

@@ -1,0 +1,175 @@
+"""Staging layer: fixed-shape batching + device staging on the virtual
+8-device CPU mesh (conftest sets XLA_FLAGS/JAX_PLATFORMS)."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data.row_block import RowBlock
+from dmlc_core_tpu.staging import (
+    Batch,
+    BatchSpec,
+    FixedShapeBatcher,
+    StagingPipeline,
+    stage_batch,
+)
+
+
+def ragged_block(sizes, base=0):
+    offset = np.zeros(len(sizes) + 1, dtype=np.int64)
+    np.cumsum(sizes, out=offset[1:])
+    nnz = int(offset[-1])
+    return RowBlock(
+        offset=offset,
+        label=np.arange(base, base + len(sizes), dtype=np.float32),
+        index=np.arange(nnz, dtype=np.uint64) % 16,
+        value=np.linspace(1, 2, nnz, dtype=np.float32) if nnz else None,
+    )
+
+
+# -- ELL layout --------------------------------------------------------------
+
+def test_ell_shapes_and_padding():
+    spec = BatchSpec(batch_size=4, layout="ell", max_nnz=3)
+    b = FixedShapeBatcher(spec)
+    blk = ragged_block([2, 3, 1])  # 3 rows < batch_size
+    batches = list(b.push(blk))
+    assert batches == []
+    tail = b.flush()
+    assert tail.batch_size == 4 and tail.n_valid == 3
+    assert tail.indices.shape == (4, 3) and tail.values.shape == (4, 3)
+    np.testing.assert_array_equal(tail.nnz, [2, 3, 1, 0])
+    np.testing.assert_array_equal(tail.weights, [1, 1, 1, 0])  # pad masked
+    # row 0 has 2 real slots, third is zero padding
+    assert tail.values[0, 2] == 0.0
+
+
+def test_ell_round_trip_values():
+    spec = BatchSpec(batch_size=2, layout="ell", max_nnz=4)
+    b = FixedShapeBatcher(spec)
+    blk = ragged_block([4, 2])
+    (batch,) = list(b.push(blk))
+    for i in range(2):
+        row = blk[i]
+        k = len(row)
+        np.testing.assert_array_equal(batch.indices[i, :k], row.index)
+        np.testing.assert_allclose(batch.values[i, :k], row.value)
+
+
+def test_ell_truncation_policy():
+    spec = BatchSpec(batch_size=1, layout="ell", max_nnz=2, overflow="truncate")
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(ragged_block([5])))
+    assert batch.nnz[0] == 2
+    assert b.truncated_nnz == 3
+    spec_err = BatchSpec(batch_size=1, layout="ell", max_nnz=2, overflow="error")
+    with pytest.raises(Exception, match="max_nnz"):
+        list(FixedShapeBatcher(spec_err).push(ragged_block([5])))
+
+
+def test_streaming_remainder_carry():
+    """Rows flow across block boundaries into exact-size batches."""
+    spec = BatchSpec(batch_size=8, layout="ell", max_nnz=4)
+    b = FixedShapeBatcher(spec)
+    out = list(b.batches(iter([ragged_block([1] * 5), ragged_block([2] * 10, 5),
+                               ragged_block([1] * 3, 15)])))
+    assert [x.n_valid for x in out] == [8, 8, 2]
+    assert b.rows_in == 18 and b.rows_out == 18
+    # labels arrive in order across the whole stream
+    all_labels = np.concatenate([x.labels[: x.n_valid] for x in out])
+    np.testing.assert_array_equal(all_labels[:5], [0, 1, 2, 3, 4])
+    np.testing.assert_array_equal(all_labels[5:15], np.arange(5, 15))
+
+
+# -- dense layout ------------------------------------------------------------
+
+def test_dense_scatter_and_duplicate_accumulate():
+    spec = BatchSpec(batch_size=2, layout="dense", num_features=8)
+    b = FixedShapeBatcher(spec)
+    blk = RowBlock(
+        offset=np.array([0, 3, 4]),
+        label=np.array([1.0, 0.0], np.float32),
+        index=np.array([1, 1, 5, 7], np.uint64),  # dup index in row 0
+        value=np.array([0.5, 0.25, 2.0, 3.0], np.float32),
+    )
+    (batch,) = list(b.push(blk))
+    assert batch.x.shape == (2, 8)
+    assert batch.x[0, 1] == pytest.approx(0.75)  # accumulated
+    assert batch.x[0, 5] == 2.0 and batch.x[1, 7] == 3.0
+
+
+def test_dense_overflow_policies():
+    blk = RowBlock(
+        offset=np.array([0, 1]), label=np.array([1.0], np.float32),
+        index=np.array([100], np.uint64), value=np.array([1.0], np.float32),
+    )
+    spec = BatchSpec(batch_size=1, layout="dense", num_features=8)
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(blk))
+    assert batch.x.sum() == 0 and b.truncated_nnz == 1
+    spec_err = BatchSpec(
+        batch_size=1, layout="dense", num_features=8, overflow="error"
+    )
+    with pytest.raises(Exception, match="num_features"):
+        list(FixedShapeBatcher(spec_err).push(blk))
+
+
+def test_binary_features_default_value_one():
+    blk = RowBlock(
+        offset=np.array([0, 2]), label=np.array([1.0], np.float32),
+        index=np.array([3, 6], np.uint64), value=None,
+    )
+    spec = BatchSpec(batch_size=1, layout="dense", num_features=8)
+    (batch,) = list(FixedShapeBatcher(spec).push(blk))
+    assert batch.x[0, 3] == 1.0 and batch.x[0, 6] == 1.0
+
+
+# -- device staging ----------------------------------------------------------
+
+def test_stage_batch_single_device():
+    import jax
+
+    spec = BatchSpec(batch_size=4, layout="dense", num_features=8)
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(ragged_block([2, 2, 1, 3])))
+    dev = stage_batch(batch)
+    assert isinstance(dev["x"], jax.Array)
+    np.testing.assert_allclose(np.asarray(dev["x"]), batch.x)
+    np.testing.assert_allclose(np.asarray(dev["labels"]), batch.labels)
+
+
+def test_stage_batch_sharded_over_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices()).reshape(8)
+    mesh = Mesh(devices, ("data",))
+    spec = BatchSpec(batch_size=16, layout="ell", max_nnz=4)
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(ragged_block([2] * 16)))
+    dev = stage_batch(batch, mesh=mesh)
+    x = dev["values"]
+    assert x.shape == (16, 4)
+    # batch dim sharded 8 ways, feature dim replicated
+    assert len(x.sharding.device_set) == 8
+    shard_shapes = {s.data.shape for s in x.addressable_shards}
+    assert shard_shapes == {(2, 4)}
+    np.testing.assert_allclose(np.asarray(x), batch.values)
+
+
+def test_staging_pipeline_end_to_end():
+    spec = BatchSpec(batch_size=8, layout="dense", num_features=16)
+    batcher = FixedShapeBatcher(spec)
+    blocks = [ragged_block([2] * 6, base=6 * i) for i in range(5)]  # 30 rows
+    pipe = StagingPipeline(batcher.batches(iter(blocks)), depth=2)
+    seen_rows = 0
+    labels = []
+    for dev in pipe:
+        arr = np.asarray(dev["labels"])
+        w = np.asarray(dev["weights"])
+        labels.extend(arr[w > 0].tolist())
+        seen_rows += int((w > 0).sum())
+    assert seen_rows == 30
+    assert pipe.rows_staged == 30 and pipe.batches_staged == 4
+    stats = pipe.throughput()
+    assert stats["rows"] == 30 and stats["rows_per_sec"] > 0
+    pipe.close()
