@@ -82,10 +82,8 @@ def run_epoch() -> dict:
     pipe = StagingPipeline(batcher.batches(iter(parser)), depth=2)
     t0 = time.perf_counter()
     last = None
-    rows = 0
     for dev in pipe:
         last = dev
-        rows += int(dev["x"].shape[0])
     if last is not None:
         jax.block_until_ready(last["x"])
     dt = time.perf_counter() - t0

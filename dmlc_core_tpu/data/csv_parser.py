@@ -19,6 +19,7 @@ from ..io.split import InputSplit
 from ..params.parameter import Parameter, field
 from ..utils.logging import Error, check, check_eq
 from . import native
+from .strtonum import I64_MAX, I64_MIN
 from .row_block import INDEX_T, REAL_T, RowBlock
 from .text_parser import TextParserBase
 
@@ -31,9 +32,6 @@ _FLOAT_PREFIX = re.compile(
     re.IGNORECASE,
 )
 _INT_PREFIX = re.compile(rb"([+-]?)(0[xX][0-9a-fA-F]+|[0-9]+)")
-
-
-_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
 
 
 def _parse_cell(cell: bytes, is_float: bool):
@@ -68,7 +66,7 @@ def _parse_cell(cell: bytes, is_float: bool):
 
 
 def _clamp_i64(v: int) -> int:
-    return min(max(v, _I64_MIN), _I64_MAX)
+    return min(max(v, I64_MIN), I64_MAX)
 
 
 class CSVParserParam(Parameter):

@@ -1,0 +1,23 @@
+"""Shared learner pieces: stable losses and the plain SGD update."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["bce_with_logits", "sgd_update"]
+
+
+def bce_with_logits(scores: jax.Array, labels: jax.Array) -> jax.Array:
+    """Numerically stable binary cross-entropy on logits, per row.
+    Labels may be {0,1} or {-1,1} (remapped here)."""
+    y = jnp.where(labels < 0.5, 0.0, 1.0)
+    return jnp.clip(scores, 0) - scores * y + jnp.log1p(
+        jnp.exp(-jnp.abs(scores))
+    )
+
+
+def sgd_update(params: Dict, grads: Dict, lr: float) -> Dict:
+    return jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)

@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.sparse import ell_matvec, weighted_mean
+from .common import bce_with_logits, sgd_update
 
 __all__ = ["FactorizationMachine"]
 
@@ -54,10 +55,7 @@ class FactorizationMachine:
 
     def loss(self, params: Params, batch: Batch) -> jax.Array:
         scores = self.forward(params, batch)
-        y = jnp.where(batch["labels"] < 0.5, 0.0, 1.0)
-        per_row = jnp.clip(scores, 0) - scores * y + jnp.log1p(
-            jnp.exp(-jnp.abs(scores))
-        )
+        per_row = bce_with_logits(scores, batch["labels"])
         data_loss = weighted_mean(per_row, batch["weights"])
         if self.l2:
             data_loss = data_loss + self.l2 * (
@@ -69,7 +67,4 @@ class FactorizationMachine:
         self, params: Params, batch: Batch, lr: float = 0.05
     ) -> Tuple[Params, jax.Array]:
         loss_val, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads
-        )
-        return new_params, loss_val
+        return sgd_update(params, grads, lr), loss_val

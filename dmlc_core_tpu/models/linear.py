@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.sparse import ell_matvec, weighted_mean
+from .common import bce_with_logits, sgd_update
 
 __all__ = ["LinearRegression", "LogisticRegression"]
 
@@ -61,10 +62,7 @@ class _LinearBase:
         """One SGD step; jit this (or wrap with parallel.data_parallel_step
         for SPMD over a mesh)."""
         loss_val, grads = jax.value_and_grad(self.loss)(params, batch)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - lr * g, params, grads
-        )
-        return new_params, loss_val
+        return sgd_update(params, grads, lr), loss_val
 
 
 class LinearRegression(_LinearBase):
@@ -85,12 +83,7 @@ class LogisticRegression(_LinearBase):
         return jax.nn.sigmoid(_scores(params, batch))
 
     def per_row_loss(self, scores: jax.Array, labels: jax.Array) -> jax.Array:
-        # numerically stable BCE on logits; labels in {0,1} (or {-1,1},
-        # remapped here)
-        y = jnp.where(labels < 0.5, 0.0, 1.0)
-        return jnp.clip(scores, 0) - scores * y + jnp.log1p(
-            jnp.exp(-jnp.abs(scores))
-        )
+        return bce_with_logits(scores, labels)
 
     def accuracy(self, params: Params, batch: Batch) -> jax.Array:
         pred = _scores(params, batch) > 0

@@ -83,9 +83,21 @@ def data_parallel_step(
 
     compiled: Dict[Any, Callable] = {}
 
+    def structure_key(params, batch, nargs: int):
+        p = tuple(
+            (str(path), leaf.ndim)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+        )
+        b = tuple(
+            (str(path), leaf.ndim)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(batch)
+        )
+        return (p, b, nargs)
+
     def run(params, batch, *args):
-        # one compile per (structure, shapes); XLA caches by jit identity
-        key = None
+        # one jit per (pytree structure, ndims) so switching batch layouts
+        # (ell ↔ dense) re-derives the shardings
+        key = structure_key(params, batch, len(args))
         fn = compiled.get(key)
         if fn is None:
             in_shardings = make_in_shardings(params, batch)

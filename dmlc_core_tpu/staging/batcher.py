@@ -129,16 +129,35 @@ class FixedShapeBatcher:
             row_ids = np.repeat(np.arange(m), nnz_per_row)
             pos = np.arange(blk.nnz) - np.repeat(blk.offset[:-1], nnz_per_row)
             keep = pos < K
+            # feature ids that don't fit the on-device index dtype (or
+            # wrapped-negative uint64s) must not silently alias another
+            # feature via astype truncation
+            idx64 = blk.index.astype(np.uint64, copy=False)
+            fits = idx64 <= np.uint64(np.iinfo(spec.index_dtype).max)
+            n_unfit = int((keep & ~fits).sum())
+            if n_unfit:
+                if spec.overflow == "error":
+                    raise Error(
+                        f"feature index {int(idx64.max())} does not fit "
+                        f"index dtype {spec.index_dtype}"
+                    )
+                self.truncated_nnz += n_unfit
+                keep &= fits
             r, p = row_ids[keep], pos[keep]
-            indices[r, p] = blk.index[keep].astype(spec.index_dtype)
+            indices[r, p] = idx64[keep].astype(spec.index_dtype)
             vals = (
                 blk.value[keep]
                 if blk.value is not None
                 else np.ones(int(keep.sum()), dtype=np.float32)
             )
             values[r, p] = vals
+            # per-row counts reflect dropped unfit features too
+            nnz_kept = np.zeros(m, dtype=np.int64)
+            np.add.at(nnz_kept, row_ids[keep], 1)
+        else:
+            nnz_kept = np.zeros(m, dtype=np.int64)
         nnz = np.zeros(B, dtype=np.int32)
-        nnz[:m] = np.minimum(nnz_per_row, K)
+        nnz[:m] = nnz_kept
         labels = np.zeros(B, dtype=np.float32)
         labels[:m] = blk.label
         weights = np.zeros(B, dtype=np.float32)
@@ -156,8 +175,11 @@ class FixedShapeBatcher:
         if blk.nnz:
             nnz_per_row = np.diff(blk.offset)
             row_ids = np.repeat(np.arange(m), nnz_per_row)
+            # compare in uint64 so wrapped-negative ids (e.g. a parsed
+            # '-5' feature) register as out of range instead of indexing
+            # from the end of the row
+            keep = blk.index.astype(np.uint64, copy=False) < np.uint64(D)
             idx = blk.index.astype(np.int64)
-            keep = idx < D
             n_over = int((~keep).sum())
             if n_over:
                 if spec.overflow == "error":

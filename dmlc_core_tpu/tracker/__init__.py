@@ -1,0 +1,27 @@
+"""Distributed launcher: rank rendezvous tracker + cluster backends.
+
+Reference: tracker/dmlc_tracker/ (SURVEY §2.6). The control plane of the
+reference's distribution story: a TCP rendezvous server that assigns ranks,
+computes the tree+ring allreduce topology, brokers peer connections, and
+relaunches through per-cluster backends. Wire-compatible with the
+reference's protocol (magic 0xff99, int/str framing) so rabit-style
+clients can connect unchanged.
+
+TPU-native additions (SURVEY §5.8): the ``tpu-pod`` backend maps the DMLC
+env contract onto jax.distributed (coordinator address, process id/count
+from the pod topology); data-plane collectives are XLA's business, so the
+tree/ring maps matter only for host-side coordination and legacy clients.
+"""
+
+from .topology import get_link_map, get_ring, get_tree
+from .tracker import PSTracker, RabitTracker, submit, worker_env
+
+__all__ = [
+    "RabitTracker",
+    "PSTracker",
+    "submit",
+    "worker_env",
+    "get_tree",
+    "get_ring",
+    "get_link_map",
+]

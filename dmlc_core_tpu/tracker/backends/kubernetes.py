@@ -1,0 +1,105 @@
+"""Kubernetes backend (reference tracker/dmlc_tracker/kubernetes.py).
+
+Synthesizes Job manifests per role (scheduler Service + worker/server
+Jobs, kubernetes.py:29-60) and submits them via the official client when
+available. --dry-run prints the manifests, which keeps the backend fully
+testable without a cluster.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .. import tracker
+from . import run_tracker_submit
+
+
+def build_job_manifest(
+    name: str,
+    image: str,
+    command: List[str],
+    envs: Dict[str, object],
+    role: str,
+    taskid: int,
+    namespace: str,
+    cores: int,
+    memory_mb: int,
+) -> Dict:
+    env_list = [
+        {"name": str(k), "value": str(v)} for k, v in sorted(
+            {**envs, "DMLC_ROLE": role, "DMLC_TASK_ID": taskid,
+             "DMLC_JOB_CLUSTER": "kubernetes"}.items()
+        )
+    ]
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {
+            "backoffLimit": 3,
+            "template": {
+                "spec": {
+                    "restartPolicy": "Never",
+                    "containers": [
+                        {
+                            "name": name,
+                            "image": image,
+                            "command": ["/bin/sh", "-c", " ".join(command)],
+                            "env": env_list,
+                            "resources": {
+                                "requests": {
+                                    "cpu": str(cores),
+                                    "memory": f"{memory_mb}Mi",
+                                }
+                            },
+                        }
+                    ],
+                }
+            },
+        },
+    }
+
+
+def build_all_manifests(args, envs: Dict[str, object]) -> List[Dict]:
+    jobname = args.jobname or "dmlc-tpu"
+    manifests = []
+    for i in range(args.num_workers):
+        manifests.append(
+            build_job_manifest(
+                f"{jobname}-worker-{i}", args.kube_worker_image,
+                list(args.command), envs, "worker", i, args.kube_namespace,
+                args.worker_cores, args.worker_memory_mb,
+            )
+        )
+    for i in range(args.num_servers):
+        manifests.append(
+            build_job_manifest(
+                f"{jobname}-server-{i}", args.kube_server_image,
+                list(args.command), envs, "server",
+                args.num_workers + i, args.kube_namespace,
+                args.server_cores, args.server_memory_mb,
+            )
+        )
+    return manifests
+
+
+def submit(args) -> None:
+    def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        manifests = build_all_manifests(args, envs)
+        if args.dry_run:
+            for m in manifests:
+                print(json.dumps(m, indent=2))
+            return
+        try:
+            from kubernetes import client, config  # type: ignore
+        except ImportError as e:
+            raise RuntimeError(
+                "kubernetes backend requires the 'kubernetes' python client"
+            ) from e
+        config.load_kube_config()
+        batch = client.BatchV1Api()
+        for m in manifests:
+            batch.create_namespaced_job(args.kube_namespace, m)
+
+    run_tracker_submit(args, launch_all)

@@ -1,0 +1,75 @@
+"""Local backend: threads spawning subprocesses with retry.
+
+Reference: tracker/dmlc_tracker/local.py. Roles by index (first
+num_workers are workers, rest servers, local.py:66-73); failed commands
+retry up to --local-num-attempt times, attempt count exported as
+DMLC_NUM_ATTEMPT (local.py:26-49; the SURVEY §5.3 process-restart story).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List
+
+from .. import tracker
+from . import run_tracker_submit
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def exec_cmd(
+    cmd: List[str],
+    num_attempt: int,
+    role: str,
+    taskid: int,
+    pass_env: Dict[str, object],
+) -> None:
+    if "/" not in cmd[0] and os.path.exists(cmd[0]):
+        cmd = ["./" + cmd[0]] + cmd[1:]
+    env = os.environ.copy()
+    for k, v in pass_env.items():
+        env[k] = str(v)
+    env["DMLC_TASK_ID"] = str(taskid)
+    env["DMLC_ROLE"] = role
+    env["DMLC_JOB_CLUSTER"] = "local"
+    num_retry = int(env.get("DMLC_NUM_ATTEMPT", num_attempt))
+    trial = 0
+    while True:
+        env["DMLC_NUM_ATTEMPT"] = str(trial)
+        ret = subprocess.call(
+            " ".join(cmd), shell=True, executable="/bin/bash", env=env
+        )
+        if ret == 0:
+            logger.debug("task %d exited with 0", taskid)
+            return
+        trial += 1
+        num_retry -= 1
+        if num_retry < 0:
+            raise RuntimeError(
+                f"nonzero return code={ret} on task {taskid}: {cmd}"
+            )
+        logger.info("task %d failed (ret=%d); retry %d", taskid, ret, trial)
+
+
+def submit(args) -> None:
+    def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        if args.dry_run:
+            for i in range(nworker + nserver):
+                role = "worker" if i < nworker else "server"
+                print(f"[dry-run] local task {i} role={role}: "
+                      f"{' '.join(args.command)}")
+            return
+        for i in range(nworker + nserver):
+            role = "worker" if i < nworker else "server"
+            t = threading.Thread(
+                target=exec_cmd,
+                args=(list(args.command), args.local_num_attempt, role, i, envs),
+                daemon=True,
+            )
+            t.start()
+
+    run_tracker_submit(args, launch_all)

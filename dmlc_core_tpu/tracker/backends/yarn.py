@@ -1,0 +1,68 @@
+"""YARN backend (reference tracker/dmlc_tracker/yarn.py + tracker/yarn/).
+
+The reference ships a Java client + ApplicationMaster with fault-tolerant
+container relaunch (SURVEY §2.6). This build generates the equivalent
+client invocation (env contract included — DMLC_MAX_ATTEMPT drives AM
+relaunch); executing it requires a Hadoop installation, so without
+$HADOOP_HOME the backend fails with a clear message (dry-run always
+works).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Dict, List
+
+from .. import tracker
+from ..opts import get_cache_file_set
+from . import run_tracker_submit
+
+
+def build_yarn_env(
+    args, envs: Dict[str, object]
+) -> Dict[str, str]:
+    out = {str(k): str(v) for k, v in envs.items()}
+    out.update(
+        DMLC_JOB_CLUSTER="yarn",
+        DMLC_WORKER_CORES=str(args.worker_cores),
+        DMLC_WORKER_MEMORY_MB=str(args.worker_memory_mb),
+        DMLC_SERVER_CORES=str(args.server_cores),
+        DMLC_SERVER_MEMORY_MB=str(args.server_memory_mb),
+        DMLC_MAX_ATTEMPT=os.getenv("DMLC_MAX_ATTEMPT", "3"),
+        DMLC_JOB_QUEUE=args.queue,
+    )
+    if args.jobname:
+        out["DMLC_JOB_NAME"] = args.jobname
+    return out
+
+
+def build_client_command(args, envs: Dict[str, object]) -> List[str]:
+    # auto-file-cache: ship command-referenced files and rewrite them to
+    # local basenames (reference yarn.py:58 + opts.get_cache_file_set)
+    fset, command = get_cache_file_set(args)
+    cmd = ["yarn", "jar", "dmlc-yarn.jar", "org.apache.hadoop.yarn.dmlc.Client"]
+    for f in sorted(fset):
+        cmd += ["-file", f]
+    cmd += ["-jobname", args.jobname or "dmlc-tpu-job"]
+    cmd += command
+    return cmd
+
+
+def submit(args) -> None:
+    def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        env = build_yarn_env(args, envs)
+        cmd = build_client_command(args, envs)
+        if args.dry_run:
+            exports = " ".join(f"{k}={v}" for k, v in sorted(env.items()))
+            print(f"[dry-run] {exports} {' '.join(cmd)}")
+            return
+        if "HADOOP_HOME" not in os.environ:
+            raise RuntimeError(
+                "yarn backend requires a Hadoop installation ($HADOOP_HOME)"
+            )
+        full = os.environ.copy()
+        full.update(env)
+        subprocess.check_call(cmd, env=full)
+
+    run_tracker_submit(args, launch_all)

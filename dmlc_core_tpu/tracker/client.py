@@ -1,0 +1,157 @@
+"""Worker-side rendezvous client (the rabit bootstrap, reimplemented).
+
+The reference repo contains only the tracker side; the worker half lives
+in downstream rabit. This client implements that wire contract so the
+framework is self-contained: connect to the tracker, receive rank +
+tree/ring neighbors, wire real TCP links to peers, and report
+shutdown/log messages. The data plane stays with XLA collectives
+(parallel/); these links carry host-side coordination only.
+
+Env bootstrap mirrors the worker contract (SURVEY §2.6):
+DMLC_TRACKER_URI/PORT, DMLC_TASK_ID as the job id for rank recovery.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Dict, List, Optional, Tuple
+
+from .protocol import MAGIC, FramedSocket
+
+__all__ = ["RabitWorker"]
+
+
+class RabitWorker:
+    """One worker's view of the rendezvous."""
+
+    def __init__(
+        self,
+        tracker_uri: Optional[str] = None,
+        tracker_port: Optional[int] = None,
+        jobid: Optional[str] = None,
+    ) -> None:
+        self.tracker_uri = tracker_uri or os.environ["DMLC_TRACKER_URI"]
+        self.tracker_port = int(
+            tracker_port
+            if tracker_port is not None
+            else os.environ["DMLC_TRACKER_PORT"]
+        )
+        self.jobid = (
+            jobid
+            if jobid is not None
+            else os.environ.get("DMLC_TASK_ID", "NULL")
+        )
+        self.rank = -1
+        self.parent = -1
+        self.world_size = -1
+        self.tree_neighbors: List[int] = []
+        self.ring_prev = -1
+        self.ring_next = -1
+        self.links: Dict[int, socket.socket] = {}
+        self._listener: Optional[socket.socket] = None
+
+    # -- tracker connection helpers -----------------------------------------
+    def _connect_tracker(self, cmd: str, rank: int, world: int) -> FramedSocket:
+        sock = socket.create_connection(
+            (self.tracker_uri, self.tracker_port), timeout=30
+        )
+        fs = FramedSocket(sock)
+        fs.send_int(MAGIC)
+        got = fs.recv_int()
+        if got != MAGIC:
+            raise ConnectionError(f"tracker sent bad magic {got:#x}")
+        fs.send_int(rank)
+        fs.send_int(world)
+        fs.send_str(str(self.jobid))
+        fs.send_str(cmd)
+        return fs
+
+    # -- rendezvous ----------------------------------------------------------
+    def start(self, world_size: int = -1, recover_rank: int = -1) -> int:
+        """Rendezvous with the tracker; wires peer links. Returns rank.
+
+        ``recover_rank`` >= 0 re-registers after a restart (cmd=recover),
+        reclaiming the previous rank (reference tracker.py:290-292).
+        """
+        self._listener = socket.socket()
+        self._listener.bind(("", 0))
+        self._listener.listen(16)
+        my_port = self._listener.getsockname()[1]
+
+        cmd = "recover" if recover_rank >= 0 else "start"
+        fs = self._connect_tracker(cmd, recover_rank, world_size)
+        self.rank = fs.recv_int()
+        self.parent = fs.recv_int()
+        self.world_size = fs.recv_int()
+        n_tree = fs.recv_int()
+        self.tree_neighbors = [fs.recv_int() for _ in range(n_tree)]
+        self.ring_prev = fs.recv_int()
+        self.ring_next = fs.recv_int()
+
+        # brokering loop: stays on this connection until every outgoing
+        # link succeeds (the tracker re-enters its loop whenever nerr != 0,
+        # reference assign_rank tracker.py:104-135)
+        expected = set(self.tree_neighbors)
+        if self.ring_prev not in (-1, self.rank):
+            expected.add(self.ring_prev)
+        if self.ring_next not in (-1, self.rank):
+            expected.add(self.ring_next)
+        while True:
+            # only report links in the current neighbor set (the tracker
+            # asserts goodset ⊆ nnset)
+            good = sorted(set(self.links) & expected)
+            fs.send_int(len(good))
+            for r in good:
+                fs.send_int(r)
+            n_conn = fs.recv_int()
+            n_wait = fs.recv_int()
+            to_connect: List[Tuple[str, int, int]] = []
+            for _ in range(n_conn):
+                host = fs.recv_str()
+                port = fs.recv_int()
+                peer_rank = fs.recv_int()
+                to_connect.append((host, port, peer_rank))
+            n_err = 0
+            for host, port, peer_rank in to_connect:
+                try:
+                    peer = socket.create_connection((host, port), timeout=30)
+                    FramedSocket(peer).send_int(self.rank)
+                    self.links[peer_rank] = peer
+                except OSError:
+                    n_err += 1
+            fs.send_int(n_err)
+            if n_err == 0:
+                break
+        fs.send_int(my_port)
+        fs.close()
+        for _ in range(n_wait):
+            peer, _addr = self._listener.accept()
+            peer_rank = FramedSocket(peer).recv_int()
+            self.links[peer_rank] = peer
+        return self.rank
+
+    # -- control messages ----------------------------------------------------
+    def log(self, msg: str) -> None:
+        """Relay a message through the tracker (cmd=print,
+        reference tracker.py:269-271)."""
+        fs = self._connect_tracker("print", self.rank, -1)
+        fs.send_str(msg)
+        fs.close()
+
+    def shutdown(self) -> None:
+        """Signal completion (cmd=shutdown, reference tracker.py:272-277)."""
+        fs = self._connect_tracker("shutdown", self.rank, -1)
+        fs.close()
+        self.close()
+
+    def close(self) -> None:
+        for s in self.links.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self.links.clear()
+        if self._listener is not None:
+            self._listener.close()
+            self._listener = None

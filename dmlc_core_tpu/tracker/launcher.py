@@ -1,0 +1,49 @@
+"""In-container bootstrap run around the user command.
+
+Reference: tracker/dmlc_tracker/launcher.py — runs INSIDE each container:
+derives the role from the task id on array schedulers (launcher.py:41-47),
+unzips shipped archives (:9-16,72-74), then execs the user command with
+the DMLC env intact.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import zipfile
+from typing import List
+
+__all__ = ["unzip_archives", "derive_role", "main"]
+
+
+def unzip_archives(archives: List[str], workdir: str = ".") -> None:
+    for ar in archives:
+        if not os.path.exists(ar):
+            continue
+        with zipfile.ZipFile(ar) as zf:
+            zf.extractall(workdir)
+
+
+def derive_role(env: dict) -> str:
+    """DMLC_ROLE, or derived from task id vs worker count on array
+    schedulers (reference launcher.py:41-47)."""
+    if env.get("DMLC_ROLE"):
+        return env["DMLC_ROLE"]
+    task_id = int(env.get("DMLC_TASK_ID", env.get("SGE_TASK_ID", 1)) or 1)
+    nworker = int(env.get("DMLC_NUM_WORKER", 1))
+    return "worker" if task_id < nworker else "server"
+
+
+def main(argv: List[str]) -> int:
+    env = os.environ.copy()
+    archives = [a for a in env.get("DMLC_JOB_ARCHIVES", "").split(":") if a]
+    unzip_archives(archives)
+    env["DMLC_ROLE"] = derive_role(env)
+    return subprocess.call(
+        " ".join(argv), shell=True, executable="/bin/bash", env=env
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

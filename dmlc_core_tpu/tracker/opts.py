@@ -1,0 +1,132 @@
+"""Submission option parsing (reference tracker/dmlc_tracker/opts.py).
+
+Same surface as the reference CLI plus the TPU-native ``tpu-pod`` cluster.
+Unknown trailing args join the command, and ``--cluster`` falls back to
+$DMLC_SUBMIT_CLUSTER, as in the reference (opts.py:166-177).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional, Set, Tuple
+
+__all__ = ["get_opts", "get_memory_mb", "get_cache_file_set"]
+
+CLUSTERS = [
+    "local",
+    "ssh",
+    "mpi",
+    "sge",
+    "slurm",
+    "yarn",
+    "mesos",
+    "kubernetes",
+    "tpu-pod",
+]
+
+
+def _str2bool(v: str) -> bool:
+    return str(v).lower() not in ("0", "false", "no", "off", "")
+
+
+def get_memory_mb(mem_str: str) -> int:
+    """'4g'/'512m' → MB (reference get_memory_mb, opts.py:39-57)."""
+    s = mem_str.lower()
+    if s.endswith("g"):
+        return int(float(s[:-1]) * 1024)
+    if s.endswith("m"):
+        return int(float(s[:-1]))
+    raise RuntimeError(
+        f"Invalid memory specification {mem_str}, need a number ending in g or m"
+    )
+
+
+def get_cache_file_set(args) -> Tuple[Set[str], List[str]]:
+    """Files referenced by the command that should ship to executors; the
+    command is rewritten to use local basenames (reference
+    get_cache_file_set, opts.py:6-36)."""
+    fset = set(args.files)
+    rewritten: List[str] = []
+    if not args.auto_file_cache:
+        return fset, list(args.command)
+    for i, token in enumerate(args.command):
+        if os.path.exists(token):
+            fset.add(token)
+            rewritten.append("./" + os.path.basename(token))
+        else:
+            rewritten.append(token)
+    return fset, rewritten
+
+
+def get_opts(args: Optional[List[str]] = None):
+    parser = argparse.ArgumentParser(description="DMLC TPU job submission.")
+    parser.add_argument(
+        "--cluster", type=str, choices=CLUSTERS, default=None,
+        help="Cluster type; defaults to $DMLC_SUBMIT_CLUSTER.",
+    )
+    parser.add_argument("--num-workers", required=True, type=int)
+    parser.add_argument("--worker-cores", default=1, type=int)
+    parser.add_argument("--worker-memory", default="1g", type=str)
+    parser.add_argument("--num-servers", default=0, type=int)
+    parser.add_argument("--server-cores", default=1, type=int)
+    parser.add_argument("--server-memory", default="1g", type=str)
+    parser.add_argument("--jobname", default=None, type=str)
+    parser.add_argument("--queue", default="default", type=str)
+    parser.add_argument(
+        "--log-level", default="INFO", choices=["INFO", "DEBUG"], type=str
+    )
+    parser.add_argument("--log-file", default=None, type=str)
+    parser.add_argument("--host-ip", default=None, type=str)
+    parser.add_argument(
+        "--host-file", default=None, type=str,
+        help="File listing host[:port], for MPI and ssh.",
+    )
+    parser.add_argument("--sge-log-dir", default=None, type=str)
+    parser.add_argument(
+        "--auto-file-cache", default=True, type=_str2bool,
+        help="Ship command-referenced files and rewrite them to basenames.",
+    )
+    parser.add_argument("--files", default=[], action="append")
+    parser.add_argument("--archives", default=[], action="append")
+    parser.add_argument("--env", action="append", default=[])
+    parser.add_argument("--sync-dst-dir", type=str, default=None)
+    parser.add_argument("--mesos-master", type=str, default=None)
+    parser.add_argument("--slurm-worker-nodes", default=None, type=int)
+    parser.add_argument("--slurm-server-nodes", default=None, type=int)
+    parser.add_argument("--kube-namespace", default="default", type=str)
+    parser.add_argument("--kube-worker-image", default="mxnet/python", type=str)
+    parser.add_argument("--kube-server-image", default="mxnet/python", type=str)
+    parser.add_argument("--local-num-attempt", default=0, type=int)
+    # tpu-pod backend (TPU-native, no reference analogue)
+    parser.add_argument(
+        "--tpu-name", default=None, type=str,
+        help="TPU pod/VM name for the tpu-pod cluster backend.",
+    )
+    parser.add_argument(
+        "--tpu-zone", default=None, type=str,
+        help="GCP zone of the TPU pod.",
+    )
+    parser.add_argument(
+        "--tpu-project", default=None, type=str,
+        help="GCP project of the TPU pod.",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true", default=False,
+        help="Print the launch commands instead of executing them.",
+    )
+    parser.add_argument("command", nargs=argparse.REMAINDER)
+    parsed = parser.parse_args(args)
+    if not parsed.command:
+        parser.error("no command to launch")
+    if parsed.command and parsed.command[0] == "--":
+        parsed.command = parsed.command[1:]
+    if parsed.cluster is None:
+        parsed.cluster = os.getenv("DMLC_SUBMIT_CLUSTER", None)
+    if parsed.cluster is None:
+        raise RuntimeError(
+            "--cluster is not specified; set it or $DMLC_SUBMIT_CLUSTER"
+        )
+    parsed.worker_memory_mb = get_memory_mb(parsed.worker_memory)
+    parsed.server_memory_mb = get_memory_mb(parsed.server_memory)
+    return parsed

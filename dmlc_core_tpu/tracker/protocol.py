@@ -1,0 +1,54 @@
+"""Wire framing for the rendezvous protocol.
+
+Reference: ExSocket (tracker.py:24-47): native-endian int32 frames and
+length-prefixed strings; magic 0xff99 handshake. Kept bit-compatible so
+rabit-style clients connect unchanged ('<i' == '@i' on every supported
+host; the reference relies on the same).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+
+MAGIC = 0xFF99
+
+__all__ = ["MAGIC", "FramedSocket"]
+
+
+class FramedSocket:
+    """recv/send of int32 and length-prefixed UTF-8 strings."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self.sock = sock
+
+    def recv_all(self, nbytes: int) -> bytes:
+        chunks = []
+        nread = 0
+        while nread < nbytes:
+            chunk = self.sock.recv(min(nbytes - nread, 65536))
+            if not chunk:
+                raise ConnectionError("peer closed during recv")
+            chunks.append(chunk)
+            nread += len(chunk)
+        return b"".join(chunks)
+
+    def recv_int(self) -> int:
+        return struct.unpack("<i", self.recv_all(4))[0]
+
+    def send_int(self, value: int) -> None:
+        self.sock.sendall(struct.pack("<i", value))
+
+    def recv_str(self) -> str:
+        return self.recv_all(self.recv_int()).decode()
+
+    def send_str(self, value: str) -> None:
+        data = value.encode()
+        self.send_int(len(data))
+        self.sock.sendall(data)
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,40 @@
+"""dmlc-submit compatible CLI (reference tracker/dmlc_tracker/submit.py).
+
+``python -m dmlc_core_tpu.tracker.submit --cluster local -n 2 cmd ...``
+Every advertised cluster dispatches (incl. ssh/slurm, which the reference
+accepted but forgot to route — SURVEY §2.6) plus the TPU-native tpu-pod.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import List, Optional
+
+from . import opts
+from .backends import get_backend
+
+__all__ = ["main"]
+
+
+def config_logger(args) -> None:
+    fmt = "%(asctime)s %(levelname)s %(message)s"
+    level = logging.DEBUG if args.log_level == "DEBUG" else logging.INFO
+    if args.log_file is None:
+        logging.basicConfig(format=fmt, level=level)
+    else:
+        logging.basicConfig(format=fmt, level=level, filename=args.log_file)
+        console = logging.StreamHandler()
+        console.setFormatter(logging.Formatter(fmt))
+        console.setLevel(level)
+        logging.getLogger("").addHandler(console)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = opts.get_opts(argv)
+    config_logger(args)
+    get_backend(args.cluster)(args)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,101 @@
+"""Allreduce topology computation: binomial tree + node-sharing ring.
+
+Reference: tracker/dmlc_tracker/tracker.py:165-252. Pure functions over
+rank counts — unit-testable without sockets.
+
+The tree is a binary heap ordering (parent (r+1)//2-1, children 2r+1,
+2r+2): latency-optimal broadcast/reduce. The ring threads through the tree
+sharing edges where possible (bandwidth-heavy allreduce + data recovery in
+rabit). ``get_link_map`` relabels ranks to follow ring order so neighbor
+ranks land on neighbor hosts.
+
+On TPU these maps are superseded by the ICI mesh (parallel/mesh.py) for
+the data plane; they remain for host-side coordination and rabit clients.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+__all__ = ["get_neighbors", "get_tree", "get_ring", "get_link_map"]
+
+
+def get_neighbors(rank: int, n: int) -> List[int]:
+    """Tree neighbors of a rank: parent first, then children
+    (reference get_neighbor, tracker.py:165-175)."""
+    out: List[int] = []
+    parent = (rank + 1) // 2 - 1
+    if parent >= 0:
+        out.append(parent)
+    left, right = 2 * rank + 1, 2 * rank + 2
+    if left < n:
+        out.append(left)
+    if right < n:
+        out.append(right)
+    return out
+
+
+def get_tree(n: int) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+    """(tree_map rank→neighbors, parent_map rank→parent; root's parent -1)."""
+    tree_map = {r: get_neighbors(r, n) for r in range(n)}
+    parent_map = {r: (r + 1) // 2 - 1 for r in range(n)}
+    return tree_map, parent_map
+
+
+def _share_ring_order(
+    tree_map: Dict[int, List[int]], parent_map: Dict[int, int], root: int
+) -> List[int]:
+    """DFS visiting order that shares edges with the tree; the last child's
+    subtree is traversed in reverse so consecutive ring hops stay adjacent
+    (reference find_share_ring, tracker.py:193-211)."""
+    children = [v for v in tree_map[root] if v != parent_map[root]]
+    if not children:
+        return [root]
+    order = [root]
+    for i, child in enumerate(children):
+        sub = _share_ring_order(tree_map, parent_map, child)
+        if i == len(children) - 1:
+            sub = sub[::-1]
+        order.extend(sub)
+    return order
+
+
+def get_ring(
+    tree_map: Dict[int, List[int]], parent_map: Dict[int, int]
+) -> Dict[int, Tuple[int, int]]:
+    """rank → (prev, next) around the shared ring (reference get_ring,
+    tracker.py:212-225)."""
+    assert parent_map[0] == -1
+    order = _share_ring_order(tree_map, parent_map, 0)
+    assert len(order) == len(tree_map), "ring must visit every rank once"
+    n = len(order)
+    ring: Dict[int, Tuple[int, int]] = {}
+    for pos in range(n):
+        ring[order[pos]] = (order[(pos - 1) % n], order[(pos + 1) % n])
+    return ring
+
+
+def get_link_map(
+    n: int,
+) -> Tuple[Dict[int, List[int]], Dict[int, int], Dict[int, Tuple[int, int]]]:
+    """Tree+ring with ranks RELABELED to follow ring order, so rank i's
+    ring-next is rank i+1 (reference get_link_map, tracker.py:227-252).
+
+    Returns (tree_map, parent_map, ring_map) in the new labeling.
+    """
+    tree_map, parent_map = get_tree(n)
+    ring_map = get_ring(tree_map, parent_map)
+    relabel = {0: 0}
+    k = 0
+    for i in range(n - 1):
+        k = ring_map[k][1]
+        relabel[k] = i + 1
+    tree2 = {relabel[r]: [relabel[x] for x in v] for r, v in tree_map.items()}
+    parent2 = {
+        relabel[r]: (relabel[p] if r != 0 else -1)
+        for r, p in parent_map.items()
+    }
+    ring2 = {
+        relabel[r]: (relabel[a], relabel[b]) for r, (a, b) in ring_map.items()
+    }
+    return tree2, parent2, ring2

@@ -1,0 +1,397 @@
+"""Rank rendezvous tracker (rabit protocol) + parameter-server bootstrap.
+
+Reference: tracker/dmlc_tracker/tracker.py (SURVEY §2.6): TCP server on
+ports 9091-9999; workers connect with cmd ∈ {start, recover, shutdown,
+print}; the tracker assigns ranks (batch, sorted by host), sends each
+worker its tree/ring neighbors, and brokers peer connections until the
+graph is wired. ``recover`` re-issues a restarted worker's previous rank
+(job-id memo) with the current neighbor endpoints — the failure-recovery
+contract rabit builds on (SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from .protocol import MAGIC, FramedSocket
+from .topology import get_link_map
+
+__all__ = [
+    "RabitTracker",
+    "PSTracker",
+    "submit",
+    "worker_env",
+    "get_host_ip",
+]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+def get_host_ip(host_ip: Optional[str] = None) -> str:
+    """Best-effort externally-visible IP (reference get_host_ip,
+    tracker.py:389-407)."""
+    if host_ip is None or host_ip == "auto":
+        host_ip = "ip"
+    if host_ip == "dns":
+        return socket.getfqdn()
+    if host_ip == "ip":
+        try:
+            ip = socket.gethostbyname(socket.getfqdn())
+        except socket.gaierror:
+            ip = socket.gethostbyname(socket.gethostname())
+        if ip.startswith("127."):
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+                probe.connect(("10.255.255.255", 1))
+                ip = probe.getsockname()[0]
+        return ip
+    return host_ip
+
+
+class WorkerEntry:
+    """One accepted worker connection through rank assignment
+    (reference SlaveEntry, tracker.py:58-135)."""
+
+    def __init__(self, conn: socket.socket, addr: Tuple) -> None:
+        self.sock = FramedSocket(conn)
+        self.host = socket.getaddrinfo(addr[0], None)[0][4][0]
+        magic = self.sock.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(
+                f"invalid magic {magic:#x} from {self.host}"
+            )
+        self.sock.send_int(MAGIC)
+        self.rank = self.sock.recv_int()
+        self.world_size = self.sock.recv_int()
+        self.jobid = self.sock.recv_str()
+        self.cmd = self.sock.recv_str()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(
+        self,
+        rank: int,
+        wait_conn: Dict[int, "WorkerEntry"],
+        tree_map: Dict[int, List[int]],
+        parent_map: Dict[int, int],
+        ring_map: Dict[int, Tuple[int, int]],
+    ) -> List[int]:
+        """Send rank/topology, then broker peer connections until this
+        worker has wired every missing link (reference assign_rank,
+        tracker.py:80-135)."""
+        self.rank = rank
+        nnset: Set[int] = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        self.sock.send_int(rank)
+        self.sock.send_int(parent_map[rank])
+        self.sock.send_int(len(tree_map))
+        self.sock.send_int(len(nnset))
+        for r in nnset:
+            self.sock.send_int(r)
+        if rprev != -1 and rprev != rank:
+            nnset.add(rprev)
+            self.sock.send_int(rprev)
+        else:
+            self.sock.send_int(-1)
+        if rnext != -1 and rnext != rank:
+            nnset.add(rnext)
+            self.sock.send_int(rnext)
+        else:
+            self.sock.send_int(-1)
+        while True:
+            ngood = self.sock.recv_int()
+            goodset = {self.sock.recv_int() for _ in range(ngood)}
+            assert goodset.issubset(nnset), (goodset, nnset)
+            badset = nnset - goodset
+            conset = [r for r in badset if r in wait_conn]
+            self.sock.send_int(len(conset))
+            self.sock.send_int(len(badset) - len(conset))
+            for r in conset:
+                self.sock.send_str(wait_conn[r].host)
+                self.sock.send_int(wait_conn[r].port)  # type: ignore[arg-type]
+                self.sock.send_int(r)
+            nerr = self.sock.recv_int()
+            if nerr != 0:
+                continue
+            self.port = self.sock.recv_int()
+            done: List[int] = []
+            for r in conset:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(badset) - len(conset)
+            return done
+
+
+class RabitTracker:
+    """Rendezvous server (reference RabitTracker, tracker.py:137-334)."""
+
+    def __init__(
+        self,
+        host_ip: str,
+        n_workers: int,
+        port: int = 9091,
+        port_end: int = 9999,
+    ) -> None:
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        bound = None
+        for p in range(port, port_end):
+            try:
+                sock.bind((host_ip, p))
+                bound = p
+                break
+            except OSError as e:
+                if e.errno in (98, 48):  # EADDRINUSE (linux, mac)
+                    continue
+                raise
+        if bound is None:
+            sock.close()
+            raise OSError(f"no free tracker port in [{port},{port_end})")
+        sock.listen(256)
+        self.sock = sock
+        self.host_ip = host_ip
+        self.port = bound
+        self.n_workers = n_workers
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        self.messages: List[str] = []  # relayed worker 'print' logs
+        logger.info("start listen on %s:%d", host_ip, self.port)
+
+    def worker_envs(self) -> Dict[str, object]:
+        """Env contract for workers (reference slave_envs,
+        tracker.py:177-183)."""
+        return {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": self.port,
+        }
+
+    # -- accept loop ---------------------------------------------------------
+    def _accept_workers(self, n_workers: int) -> None:
+        shutdown: Dict[int, WorkerEntry] = {}
+        wait_conn: Dict[int, WorkerEntry] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[WorkerEntry] = []
+        todo_nodes: List[int] = []
+        tree_map = parent_map = ring_map = None
+
+        while len(shutdown) != n_workers:
+            conn, addr = self.sock.accept()
+            try:
+                entry = WorkerEntry(conn, addr)
+            except (ConnectionError, OSError) as e:
+                logger.warning("bad handshake: %s", e)
+                conn.close()
+                continue
+            if entry.cmd == "print":
+                msg = entry.sock.recv_str()
+                self.messages.append(msg.strip())
+                logger.info("%s", msg.strip())
+                continue
+            if entry.cmd == "shutdown":
+                assert entry.rank >= 0 and entry.rank not in shutdown
+                assert entry.rank not in wait_conn
+                shutdown[entry.rank] = entry
+                logger.debug("shutdown signal from %d", entry.rank)
+                continue
+            assert entry.cmd in ("start", "recover"), entry.cmd
+            if tree_map is None:
+                assert entry.cmd == "start"
+                if entry.world_size > 0:
+                    n_workers = entry.world_size
+                    self.n_workers = n_workers
+                tree_map, parent_map, ring_map = get_link_map(n_workers)
+                todo_nodes = list(range(n_workers))
+            else:
+                assert entry.world_size in (-1, n_workers)
+            if entry.cmd == "recover":
+                assert entry.rank >= 0
+            rank = entry.decide_rank(job_map)
+            if rank == -1:
+                assert todo_nodes, "no free rank left"
+                pending.append(entry)
+                if len(pending) == len(todo_nodes):
+                    # batch assignment sorted by host for locality
+                    # (reference accept_slaves, tracker.py:293-311)
+                    pending.sort(key=lambda e: e.host)
+                    for entry in pending:
+                        rank = todo_nodes.pop(0)
+                        if entry.jobid != "NULL":
+                            job_map[entry.jobid] = rank
+                        entry.assign_rank(
+                            rank, wait_conn, tree_map, parent_map, ring_map
+                        )
+                        if entry.wait_accept > 0:
+                            wait_conn[rank] = entry
+                        logger.debug(
+                            "%s from %s; assigned rank %d",
+                            entry.cmd, entry.host, entry.rank,
+                        )
+                    pending = []
+                if not todo_nodes:
+                    logger.info(
+                        "@tracker all of %d nodes are started", n_workers
+                    )
+                    self.start_time = time.time()
+            else:
+                entry.assign_rank(
+                    rank, wait_conn, tree_map, parent_map, ring_map
+                )
+                logger.debug("%s signal from %d", entry.cmd, entry.rank)
+                if entry.wait_accept > 0:
+                    wait_conn[entry.rank] = entry
+        logger.info("@tracker all nodes finished the job")
+        self.end_time = time.time()
+        if self.start_time is not None:
+            logger.info(
+                "@tracker %.3f secs between node start and job finish",
+                self.end_time - self.start_time,
+            )
+
+    def start(self, n_workers: Optional[int] = None) -> None:
+        self.thread = threading.Thread(
+            target=self._accept_workers,
+            args=(n_workers or self.n_workers,),
+            daemon=True,
+            name="rabit-tracker",
+        )
+        self.thread.start()
+
+    def join(self) -> None:
+        while self.thread is not None and self.thread.is_alive():
+            self.thread.join(0.1)
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSTracker:
+    """Parameter-server bootstrap: launches the scheduler locally with
+    DMLC_ROLE=scheduler + root URI/port; workers/servers connect to the
+    root directly, no rendezvous (reference PSTracker,
+    tracker.py:336-386)."""
+
+    def __init__(
+        self,
+        host_ip: str,
+        cmd: Optional[str],
+        port: int = 9091,
+        port_end: int = 9999,
+        envs: Optional[Dict[str, object]] = None,
+    ) -> None:
+        self.cmd = cmd
+        self.thread: Optional[threading.Thread] = None
+        if cmd is None:
+            return
+        self.host_ip = host_ip
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        self.port = None
+        for p in range(port, port_end):
+            with socket.socket(family, socket.SOCK_STREAM) as probe:
+                try:
+                    probe.bind(("", p))
+                    self.port = p
+                    break
+                except OSError:
+                    continue
+        assert self.port is not None, "no free PS root port"
+        env = os.environ.copy()
+        env["DMLC_ROLE"] = "scheduler"
+        env["DMLC_PS_ROOT_URI"] = str(host_ip)
+        env["DMLC_PS_ROOT_PORT"] = str(self.port)
+        for k, v in (envs or {}).items():
+            env[k] = str(v)
+
+        def run() -> None:
+            subprocess.check_call(
+                self.cmd, env=env, shell=True, executable="/bin/bash"
+            )
+
+        self.thread = threading.Thread(target=run, daemon=True, name="ps-sched")
+        self.thread.start()
+
+    def worker_envs(self) -> Dict[str, object]:
+        if self.cmd is None:
+            return {}
+        return {
+            "DMLC_PS_ROOT_URI": self.host_ip,
+            "DMLC_PS_ROOT_PORT": self.port,
+        }
+
+    def join(self) -> None:
+        while self.thread is not None and self.thread.is_alive():
+            self.thread.join(0.1)
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+
+def worker_env(n_workers: int, n_servers: int) -> Dict[str, object]:
+    """Base env every launched process receives (reference submit,
+    tracker.py:413-415)."""
+    return {
+        "DMLC_NUM_WORKER": n_workers,
+        "DMLC_NUM_SERVER": n_servers,
+    }
+
+
+def submit(
+    n_workers: int,
+    n_servers: int,
+    fun_submit: Callable[[int, int, Dict[str, object]], None],
+    host_ip: str = "auto",
+    pscmd: Optional[str] = None,
+    dry_run: bool = False,
+) -> None:
+    """Start the right tracker, hand worker envs to the cluster-specific
+    launcher, wait for completion (reference tracker.submit,
+    tracker.py:410-433).
+
+    ``dry_run`` skips the tracker entirely (no rendezvous to wait on) and
+    hands fun_submit placeholder tracker envs so backends can print their
+    launch commands."""
+    if n_servers == 0:
+        pscmd = None
+    envs = worker_env(n_workers, n_servers)
+    if dry_run:
+        envs.update(
+            {"DMLC_TRACKER_URI": get_host_ip(host_ip), "DMLC_TRACKER_PORT": 9091}
+        )
+        fun_submit(n_workers, n_servers, envs)
+        return
+    ip = get_host_ip(host_ip)
+    if n_servers == 0:
+        rabit = RabitTracker(host_ip=ip, n_workers=n_workers)
+        envs.update(rabit.worker_envs())
+        rabit.start(n_workers)
+        if rabit.alive():
+            fun_submit(n_workers, n_servers, envs)
+        rabit.join()
+        rabit.close()
+    else:
+        ps = PSTracker(host_ip=ip, cmd=pscmd, envs=envs)
+        envs.update(ps.worker_envs())
+        if ps.alive():
+            fun_submit(n_workers, n_servers, envs)
+        ps.join()

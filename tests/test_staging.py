@@ -173,3 +173,42 @@ def test_staging_pipeline_end_to_end():
     stats = pipe.throughput()
     assert stats["rows"] == 30 and stats["rows_per_sec"] > 0
     pipe.close()
+
+
+def test_dense_wrapped_negative_index_is_overflow():
+    """A parsed '-5' feature wraps to 2^64-5; it must count as overflow,
+    not scatter into column D-5."""
+    blk = RowBlock(
+        offset=np.array([0, 1]), label=np.array([1.0], np.float32),
+        index=np.array([np.uint64(2**64 - 5)], np.uint64),
+        value=np.array([3.0], np.float32),
+    )
+    spec = BatchSpec(batch_size=1, layout="dense", num_features=8)
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(blk))
+    assert batch.x.sum() == 0 and b.truncated_nnz == 1
+    spec_err = BatchSpec(
+        batch_size=1, layout="dense", num_features=8, overflow="error"
+    )
+    with pytest.raises(Exception):
+        list(FixedShapeBatcher(spec_err).push(blk))
+
+
+def test_ell_index_dtype_overflow_guard():
+    """Feature ids beyond int32 must not silently wrap in the ELL array."""
+    blk = RowBlock(
+        offset=np.array([0, 2]), label=np.array([1.0], np.float32),
+        index=np.array([3, 3_000_000_000], np.uint64),
+        value=np.array([1.0, 2.0], np.float32),
+    )
+    spec = BatchSpec(batch_size=1, layout="ell", max_nnz=4)
+    b = FixedShapeBatcher(spec)
+    (batch,) = list(b.push(blk))
+    assert b.truncated_nnz == 1
+    assert batch.nnz[0] == 1
+    assert (batch.indices >= 0).all()
+    spec_err = BatchSpec(
+        batch_size=1, layout="ell", max_nnz=4, overflow="error"
+    )
+    with pytest.raises(Exception, match="does not fit"):
+        list(FixedShapeBatcher(spec_err).push(blk))
