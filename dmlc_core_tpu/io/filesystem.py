@@ -90,6 +90,12 @@ class FileSystem:
         proto = URI(uri).protocol or "file://"
         entry = FS_REGISTRY.find(proto)
         if entry is None:
+            # any miss: load the cloud backends once and re-check, so
+            # cloudfs.py stays the single source of truth for protocols
+            from . import cloudfs  # noqa: F401 — registers cloud backends
+
+            entry = FS_REGISTRY.find(proto)
+        if entry is None:
             raise Error(
                 f"unknown filesystem protocol {proto!r} in {uri!r}; "
                 f"registered: {sorted(FS_REGISTRY.names())}"
