@@ -114,6 +114,24 @@ class HttpReadStream(SeekStream):
                 self._resp = None
                 return
             raise
+        if self._pos and "Range" in headers:
+            # a server/proxy that ignores Range would silently serve byte 0
+            # as if it were byte _pos — corrupt shards, no error. Demand
+            # proof the range was honored.
+            status = getattr(self._resp, "status", 206)
+            crange = self._resp.headers.get("Content-Range", "")
+            start = None
+            if crange.startswith("bytes "):
+                try:
+                    start = int(crange[6:].split("-")[0])
+                except ValueError:
+                    start = None
+            if status != 206 or start != self._pos:
+                self._drop()
+                raise Error(
+                    f"server ignored Range request at offset {self._pos} "
+                    f"for {url} (status {status}, Content-Range {crange!r})"
+                )
         if self._size is None:
             total = _total_from_response(self._resp)
             if total is not None:
@@ -128,6 +146,8 @@ class HttpReadStream(SeekStream):
             self._resp = None
 
     def read(self, n: int = -1) -> bytes:
+        if n == 0:
+            return b""
         retries = 3
         while True:
             if self._resp is None:
@@ -505,7 +525,11 @@ class S3FileSystem(FileSystem):
             }
             if token:
                 q["continuation-token"] = token
-            url = base + "/?" + urllib.parse.urlencode(sorted(q.items()))
+            # quote_via=quote: S3 canonicalizes spaces as %20, and '+' in
+            # the wire query would be decoded as a space server-side
+            url = base + "/?" + urllib.parse.urlencode(
+                sorted(q.items()), quote_via=urllib.parse.quote
+            )
             body = self.request("GET", url)
             root = ET.fromstring(body)
             for el in root.iter():

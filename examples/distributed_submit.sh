@@ -1,0 +1,13 @@
+#!/bin/bash
+# Launch patterns for dmlc-submit (reference tracker/dmlc-submit usage).
+set -e
+cd "$(dirname "$0")/.."
+
+# 2 local workers with rendezvous (each reads its shard of the data)
+./dmlc-submit --cluster local --num-workers 2 --host-ip 127.0.0.1 \
+    python examples/train_higgs.py /tmp/higgs_demo.libsvm
+
+# what a TPU pod launch would run (printed, not executed):
+./dmlc-submit --cluster tpu-pod --num-workers 4 --dry-run \
+    --tpu-name my-pod --tpu-zone us-central2-b \
+    python examples/train_higgs.py gs://bucket/higgs.libsvm

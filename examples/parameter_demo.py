@@ -1,0 +1,37 @@
+"""Parameter module walkthrough (reference example/parameter.cc).
+
+Run: python examples/parameter_demo.py learning_rate=0.1 name=demo
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dmlc_core_tpu.params.parameter import Parameter, field
+
+
+class TrainParam(Parameter):
+    learning_rate = field(float, default=0.01, lower=0.0, help="Step size.")
+    num_hidden = field(int, default=128, lower=1, upper=4096, help="Hidden units.")
+    activation = field(
+        str,
+        default="relu",
+        enum={"relu": "relu", "sigmoid": "sigmoid", "tanh": "tanh"},
+        help="Nonlinearity.",
+    )
+    name = field(str, required=True, help="Run name.")
+    silent = field(bool, default=False, aliases=("quiet",), help="Mute logs.")
+
+
+def main() -> None:
+    kwargs = dict(kv.split("=", 1) for kv in sys.argv[1:])
+    p = TrainParam()
+    p.init(kwargs)
+    print("initialized:", p.to_dict())
+    print("\ngenerated docs:\n" + TrainParam.doc())
+    print("json round-trip:", p.save_json())
+
+
+if __name__ == "__main__":
+    main()
