@@ -80,6 +80,11 @@ class BatchSpec:
     num_features: Optional[int] = None
     overflow: str = "truncate"
     index_dtype: np.dtype = np.dtype(np.int32)
+    # dtype of the feature VALUES staged to the device (labels/weights stay
+    # float32 — they're tiny). float16 halves infeed DMA bytes; models
+    # upcast on device (standard TPU infeed trick; values like HIGGS's
+    # N(0,1) features lose nothing that bf16 compute wouldn't lose anyway)
+    value_dtype: np.dtype = np.dtype(np.float32)
 
     def __post_init__(self) -> None:
         check(self.layout in ("ell", "dense"), f"bad layout {self.layout!r}")
@@ -123,9 +128,30 @@ class FixedShapeBatcher:
                 )
             self.truncated_nnz += n_over
         indices = np.zeros((B, K), dtype=spec.index_dtype)
-        values = np.zeros((B, K), dtype=np.float32)
+        values = np.zeros((B, K), dtype=spec.value_dtype)
         m = len(nnz_per_row)
-        if blk.nnz:
+        # fast path: uniform row width that fits K and the index dtype →
+        # plain reshape+copy, no position scatter
+        k0 = int(nnz_per_row[0]) if m else 0
+        if (
+            blk.nnz
+            and 0 < k0 <= K
+            and np.all(nnz_per_row == k0)
+            and blk.index.size
+            and int(blk.index.astype(np.uint64, copy=False).max())
+            <= np.iinfo(spec.index_dtype).max
+        ):
+            indices[:m, :k0] = blk.index.reshape(m, k0).astype(
+                spec.index_dtype, copy=False
+            )
+            vals = (
+                blk.value
+                if blk.value is not None
+                else np.ones(blk.nnz, dtype=np.float32)
+            )
+            values[:m, :k0] = vals.reshape(m, k0)
+            nnz_kept = np.full(m, k0, dtype=np.int64)
+        elif blk.nnz:
             row_ids = np.repeat(np.arange(m), nnz_per_row)
             pos = np.arange(blk.nnz) - np.repeat(blk.offset[:-1], nnz_per_row)
             keep = pos < K
@@ -170,11 +196,10 @@ class FixedShapeBatcher:
     def _to_dense(self, blk: RowBlock, n_valid: int) -> Batch:
         spec = self.spec
         B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        x = np.zeros((B, D), dtype=np.float32)
+        x = np.zeros((B, D), dtype=spec.value_dtype)
         m = blk.size
         if blk.nnz:
             nnz_per_row = np.diff(blk.offset)
-            row_ids = np.repeat(np.arange(m), nnz_per_row)
             # compare in uint64 so wrapped-negative ids (e.g. a parsed
             # '-5' feature) register as out of range instead of indexing
             # from the end of the row
@@ -192,9 +217,22 @@ class FixedShapeBatcher:
                 if blk.value is not None
                 else np.ones(blk.nnz, dtype=np.float32)
             )
-            # duplicate indices within a row accumulate, matching sparse
-            # dot semantics
-            np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
+            # fast path: uniform row width + strictly-increasing indices
+            # (every tabular format: HIGGS, Criteo, CSV output) → one fancy
+            # assignment instead of the much slower np.add.at scatter
+            k0 = int(nnz_per_row[0]) if m else 0
+            uniform = k0 > 0 and not n_over and np.all(nnz_per_row == k0)
+            if uniform:
+                idx2 = idx.reshape(m, k0)
+                if k0 == 1 or np.all(idx2[:, 1:] > idx2[:, :-1]):
+                    x[np.arange(m)[:, None], idx2] = vals.reshape(m, k0)
+                else:
+                    uniform = False
+            if not uniform:
+                row_ids = np.repeat(np.arange(m), nnz_per_row)
+                # duplicate indices within a row accumulate, matching
+                # sparse dot semantics
+                np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
         labels = np.zeros(B, dtype=np.float32)
         labels[:m] = blk.label
         weights = np.zeros(B, dtype=np.float32)

@@ -60,8 +60,9 @@ def main() -> None:
         for batch in pipe:
             params, loss = step(params, batch)
         stats = pipe.throughput()
+        loss_str = "n/a (empty shard)" if loss is None else f"{float(loss):.4f}"
         print(
-            f"rank {rank} epoch {epoch}: loss={float(loss):.4f} "
+            f"rank {rank} epoch {epoch}: loss={loss_str} "
             f"({stats['rows_per_sec']:,.0f} rows/s into device)"
         )
         parser.close()

@@ -11,6 +11,7 @@
 // dmlc_core_tpu/data/{libsvm,csv,libfm}_parser.py exactly; the parity is
 // enforced by tests/test_native.py which parses identical inputs both ways.
 
+#include <array>
 #include <charconv>
 #include <cctype>
 #include <cstdint>
@@ -73,8 +74,14 @@ ParseResult* finish(Holder* h) {
 
 // matches Python bytes.split() whitespace (minus \n, which is a line
 // terminator here): space, tab, CR, vertical tab, form feed
+constexpr auto kBlankLut = [] {
+  std::array<bool, 256> t{};
+  t[' '] = t['\t'] = t['\r'] = t['\v'] = t['\f'] = true;
+  return t;
+}();
+
 inline bool is_blank(char c) {
-  return c == ' ' || c == '\t' || c == '\r' || c == '\v' || c == '\f';
+  return kBlankLut[static_cast<unsigned char>(c)];
 }
 
 // -- number parsing ----------------------------------------------------------
@@ -91,11 +98,12 @@ inline const char* skip_plus(const char* b, const char* e) {
 // optional dot, no exponent. mantissa < 10^15 < 2^53 and the 10^k divisor
 // are both exact doubles, so one division gives the correctly-rounded
 // result — bit-identical to from_chars. Everything else returns false.
+constexpr double kPow10[23] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+    1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+    1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+
 inline bool parse_float_simple(const char* b, const char* e, double* out) {
-  static constexpr double kPow10[23] = {
-      1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
-      1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
-      1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
   const char* p = b;
   bool neg = false;
   if (p != e && (*p == '+' || *p == '-')) neg = (*p++ == '-');
@@ -221,62 +229,134 @@ DMLC_API ParseResult* dmlc_parse_libsvm(const char* buf, int64_t len,
     const char* le = ln.e;
     const void* hash = memchr(lb, '#', static_cast<size_t>(le - lb));
     if (hash) le = static_cast<const char*>(hash);
-    bool first = true;
-    bool row_open = false;
-    int tok_i = 0;
-    for_each_token(lb, le, [&](const char* tb, const char* te) {
-      if (first) {
-        first = false;
-        const char* colon =
-            static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
-        double lab, w = 1.0;
-        bool has_w = false;
-        if (colon) {
-          if (!parse_float_full(tb, colon, &lab) ||
-              !parse_float_full(colon + 1, te, &w))
-            return false;  // non-numeric label token: skip line
-          has_w = true;
-        } else if (!parse_float_full(tb, te, &lab)) {
-          return false;
-        }
-        h->label.push_back(static_cast<float>(lab));
-        h->weight.push_back(static_cast<float>(w));
-        h->qid.push_back(0);
-        if (has_w) any_weight = true;
-        row_open = true;
-        tok_i = 1;
-        return true;
+
+    // ---- label token ----
+    const char* p = lb;
+    while (p < le && is_blank(*p)) ++p;
+    if (p >= le) return;
+    const char* te = p;
+    while (te < le && !is_blank(*te)) ++te;
+    {
+      const char* colon =
+          static_cast<const char*>(memchr(p, ':', static_cast<size_t>(te - p)));
+      double lab, w = 1.0;
+      bool has_w = false;
+      if (colon) {
+        if (!parse_float_full(p, colon, &lab) ||
+            !parse_float_full(colon + 1, te, &w))
+          return;  // non-numeric label token: skip line
+        has_w = true;
+      } else if (!parse_float_full(p, te, &lab)) {
+        return;
       }
-      if (tok_i == 1 && te - tb >= 4 && memcmp(tb, "qid:", 4) == 0) {
+      h->label.push_back(static_cast<float>(lab));
+      h->weight.push_back(static_cast<float>(w));
+      h->qid.push_back(0);
+      if (has_w) any_weight = true;
+    }
+    p = te;
+
+    // ---- optional qid token (second token only) ----
+    while (p < le && is_blank(*p)) ++p;
+    {
+      const char* qe = p;
+      while (qe < le && !is_blank(*qe)) ++qe;
+      if (qe - p >= 4 && memcmp(p, "qid:", 4) == 0) {
         int64_t q = 0;
-        if (parse_i64_full(tb + 4, te, &q)) {
+        if (parse_i64_full(p + 4, qe, &q)) {
           h->qid.back() = q;
         }  // garbage qid -> 0, keep parsing (reference atoll)
         any_qid = true;
-        tok_i = 2;
-        return true;
+        p = qe;
       }
-      tok_i = 2;
+    }
+
+    // ---- feature tokens: fused scan+parse; anything unusual (signs,
+    // exponents, inf/nan, >15-digit mantissas, malformed) falls back to
+    // the exact token-level helpers so semantics stay identical ----
+    while (p < le) {
+      while (p < le && is_blank(*p)) ++p;
+      if (p >= le) break;
+      // fused scan+parse: each fast-path char is visited exactly once
+      const char* q = p;
+      uint64_t feat = 0;
+      int fd = 0;
+      while (q < le && *q >= '0' && *q <= '9' && fd <= 18) {
+        feat = feat * 10 + static_cast<uint64_t>(*q - '0');
+        ++q;
+        ++fd;
+      }
+      if (fd > 0 && fd <= 18) {
+        if (q >= le || is_blank(*q)) {
+          // bare integer feature (binary, value 1)
+          h->index.push_back(feat);
+          h->value.push_back(1.0f);
+          if (static_cast<int64_t>(feat) < min_feat)
+            min_feat = static_cast<int64_t>(feat);
+          p = q;
+          continue;
+        }
+        if (*q == ':') {
+          ++q;
+          bool neg = false;
+          if (q < le && *q == '-') {
+            neg = true;
+            ++q;
+          }
+          uint64_t mant = 0;
+          int digits = 0, frac = 0;
+          bool dot = false, fok = true, any = false;
+          for (; q < le; ++q) {
+            const char c = *q;
+            if (c >= '0' && c <= '9') {
+              if (++digits > 15) {
+                fok = false;
+                break;
+              }
+              mant = mant * 10 + static_cast<uint64_t>(c - '0');
+              any = true;
+              if (dot) ++frac;
+            } else if (c == '.' && !dot) {
+              dot = true;
+            } else {
+              break;  // fok stays true only if this is a token boundary
+            }
+          }
+          if (fok && any && (q >= le || is_blank(*q))) {
+            const double v = static_cast<double>(mant) / kPow10[frac];
+            h->index.push_back(feat);
+            h->value.push_back(static_cast<float>(neg ? -v : v));
+            any_value = true;
+            if (static_cast<int64_t>(feat) < min_feat)
+              min_feat = static_cast<int64_t>(feat);
+            p = q;
+            continue;
+          }
+        }
+      }
+      // slow path: exact token-level parse over the full token
+      te = p;
+      while (te < le && !is_blank(*te)) ++te;
       const char* colon =
-          static_cast<const char*>(memchr(tb, ':', static_cast<size_t>(te - tb)));
-      int64_t feat;
+          static_cast<const char*>(memchr(p, ':', static_cast<size_t>(te - p)));
+      int64_t sfeat;
       if (colon) {
         double v;
-        if (!parse_i64_full(tb, colon, &feat) ||
-            !parse_float_full(colon + 1, te, &v))
-          return true;  // malformed token: skip it
-        h->index.push_back(static_cast<uint64_t>(feat));
-        h->value.push_back(static_cast<float>(v));
-        any_value = true;
-      } else {
-        if (!parse_i64_full(tb, te, &feat)) return true;
-        h->index.push_back(static_cast<uint64_t>(feat));
+        if (parse_i64_full(p, colon, &sfeat) &&
+            parse_float_full(colon + 1, te, &v)) {
+          h->index.push_back(static_cast<uint64_t>(sfeat));
+          h->value.push_back(static_cast<float>(v));
+          any_value = true;
+          if (sfeat < min_feat) min_feat = sfeat;
+        }
+      } else if (parse_i64_full(p, te, &sfeat)) {
+        h->index.push_back(static_cast<uint64_t>(sfeat));
         h->value.push_back(1.0f);
+        if (sfeat < min_feat) min_feat = sfeat;
       }
-      if (feat < min_feat) min_feat = feat;
-      return true;
-    });
-    if (row_open) h->offset.push_back(static_cast<int64_t>(h->index.size()));
+      p = te;
+    }
+    h->offset.push_back(static_cast<int64_t>(h->index.size()));
   });
   if (indexing_mode > 0 ||
       (indexing_mode < 0 && !h->index.empty() && min_feat > 0)) {
