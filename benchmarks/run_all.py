@@ -1,0 +1,238 @@
+"""Benchmark runner for every BASELINE.json config.
+
+Writes benchmarks/RESULTS.json and prints one line per config. The driver's
+single-line metric stays in bench.py (north-star: HIGGS rows/sec into HBM);
+this runner gives the per-config breakdown:
+
+1. libsvm_parser_test: HIGGS-like file → RowBlockIter
+2. csv_parser + libfm_parser → RowBlockIter
+3. RecordIO pack/read roundtrip with ThreadedIter prefetch
+4. InputSplit sharded read over local + s3:// (hermetic fake) URIs
+5. dmlc-submit multi-worker rank/world env (local backend, real rendezvous)
+
+Run: python benchmarks/run_all.py  [BENCH_ROWS=... scales the data]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", "100000"))
+RESULTS = {}
+
+
+def timed(fn):
+    t0 = time.perf_counter()
+    out = fn()
+    return out, time.perf_counter() - t0
+
+
+def gen_libsvm(path: str, rows: int, d: int = 28) -> None:
+    rng = np.random.default_rng(0)
+    with open(path, "w") as f:
+        for start in range(0, rows, 10000):
+            n = min(10000, rows - start)
+            vals = rng.normal(size=(n, d))
+            f.write(
+                "".join(
+                    "%d %s\n"
+                    % (
+                        i % 2,
+                        " ".join(f"{j}:{vals[i, j]:.6f}" for j in range(d)),
+                    )
+                    for i in range(n)
+                )
+            )
+
+
+def bench_libsvm(tmp: str) -> None:
+    from dmlc_core_tpu import data as D
+
+    path = os.path.join(tmp, "higgs.libsvm")
+    gen_libsvm(path, N_ROWS)
+    it, dt = timed(lambda: D.create_row_block_iter(path, type="libsvm"))
+    rows = sum(b.size for b in it)
+    assert rows == N_ROWS
+    RESULTS["libsvm_rowblockiter_rows_per_sec"] = round(rows / dt, 1)
+
+
+def bench_csv_libfm(tmp: str) -> None:
+    from dmlc_core_tpu import data as D
+
+    rng = np.random.default_rng(1)
+    csv = os.path.join(tmp, "t.csv")
+    with open(csv, "w") as f:
+        for start in range(0, N_ROWS, 10000):
+            n = min(10000, N_ROWS - start)
+            m = rng.normal(size=(n, 14))
+            f.write(
+                "".join(",".join(f"{v:.5f}" for v in row) + "\n" for row in m)
+            )
+    it, dt = timed(lambda: D.create_row_block_iter(csv, type="csv"))
+    rows = sum(b.size for b in it)
+    assert rows == N_ROWS
+    RESULTS["csv_rowblockiter_rows_per_sec"] = round(rows / dt, 1)
+
+    fm = os.path.join(tmp, "t.libfm")
+    nfm = N_ROWS // 2
+    with open(fm, "w") as f:
+        for start in range(0, nfm, 10000):
+            n = min(10000, nfm - start)
+            vals = rng.normal(size=(n, 8))
+            f.write(
+                "".join(
+                    "%d %s\n"
+                    % (
+                        i % 2,
+                        " ".join(
+                            f"{j % 4}:{j}:{vals[i, j]:.5f}" for j in range(8)
+                        ),
+                    )
+                    for i in range(n)
+                )
+            )
+    it, dt = timed(lambda: D.create_row_block_iter(fm, type="libfm"))
+    rows = sum(b.size for b in it)
+    assert rows == nfm
+    RESULTS["libfm_rowblockiter_rows_per_sec"] = round(rows / dt, 1)
+
+
+def bench_recordio(tmp: str) -> None:
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.io.recordio import RecordIOReader, RecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream
+
+    path = os.path.join(tmp, "data.rec")
+    rng = np.random.default_rng(2)
+    n_rec = max(N_ROWS // 10, 1000)
+    payloads = [rng.bytes(512) for _ in range(200)]
+    t0 = time.perf_counter()
+    with FileStream(path, "w") as f:
+        w = RecordIOWriter(f)
+        for i in range(n_rec):
+            w.write_record(payloads[i % 200])
+    dt_w = time.perf_counter() - t0
+    size = os.path.getsize(path)
+    RESULTS["recordio_write_mb_per_sec"] = round(size / dt_w / 1e6, 1)
+
+    t0 = time.perf_counter()
+    with FileStream(path, "r") as f:
+        r = RecordIOReader(f)
+        count = sum(1 for _ in r)
+    dt_r = time.perf_counter() - t0
+    assert count == n_rec
+    RESULTS["recordio_read_mb_per_sec"] = round(size / dt_r / 1e6, 1)
+
+    # threaded-prefetch split read (the ThreadedIter pipeline)
+    t0 = time.perf_counter()
+    sp = io_split.create(path, 0, 1, type="recordio")
+    count = sum(1 for _ in sp)
+    sp.close()
+    dt_s = time.perf_counter() - t0
+    assert count == n_rec
+    RESULTS["recordio_threaded_split_mb_per_sec"] = round(size / dt_s / 1e6, 1)
+
+
+def bench_sharded_split(tmp: str) -> None:
+    from dmlc_core_tpu.io import split as io_split
+
+    path = os.path.join(tmp, "higgs.libsvm")  # reuse from bench_libsvm
+    size = os.path.getsize(path)
+    t0 = time.perf_counter()
+    total = 0
+    for rank in range(4):
+        sp = io_split.create(path, rank, 4, type="text")
+        total += sum(1 for _ in sp)
+        sp.close()
+    dt = time.perf_counter() - t0
+    assert total == N_ROWS
+    RESULTS["inputsplit_local_4shard_mb_per_sec"] = round(size / dt / 1e6, 1)
+
+    # s3:// via the hermetic fake (signed, ranged)
+    from test_cloudfs import FakeS3Handler, _Server
+    from dmlc_core_tpu.io.cloudfs import reset_singletons
+
+    FakeS3Handler.STORE = {"bkt/higgs.libsvm": open(path, "rb").read()}
+    srv = _Server(FakeS3Handler)
+    os.environ["S3_ENDPOINT"] = srv.url
+    os.environ["AWS_ACCESS_KEY_ID"] = FakeS3Handler.ACCESS
+    os.environ["AWS_SECRET_ACCESS_KEY"] = FakeS3Handler.SECRET
+    reset_singletons()
+    try:
+        t0 = time.perf_counter()
+        total = 0
+        for rank in range(2):
+            sp = io_split.create("s3://bkt/higgs.libsvm", rank, 2, type="text")
+            total += sum(1 for _ in sp)
+            sp.close()
+        dt = time.perf_counter() - t0
+        assert total == N_ROWS
+        RESULTS["inputsplit_s3_2shard_mb_per_sec"] = round(size / dt / 1e6, 1)
+    finally:
+        reset_singletons()
+        srv.stop()
+        os.environ.pop("S3_ENDPOINT")
+
+
+def bench_submit(tmp: str) -> None:
+    worker = os.path.join(tmp, "worker.py")
+    out = os.path.join(tmp, "rank")
+    with open(worker, "w") as f:
+        f.write(
+            f"""
+import os, sys
+sys.path.insert(0, {REPO!r})
+from dmlc_core_tpu.tracker.client import RabitWorker
+w = RabitWorker()
+rank = w.start()
+open({out!r} + str(rank), "w").write(os.environ["DMLC_ROLE"])
+w.shutdown()
+"""
+        )
+    from dmlc_core_tpu.tracker import opts as tr_opts
+    from dmlc_core_tpu.tracker.backends import get_backend
+
+    t0 = time.perf_counter()
+    args = tr_opts.get_opts(
+        ["--cluster", "local", "--num-workers", "4",
+         "--host-ip", "127.0.0.1", sys.executable, worker]
+    )
+    get_backend("local")(args)
+    dt = time.perf_counter() - t0
+    assert all(os.path.exists(out + str(r)) for r in range(4))
+    RESULTS["dmlc_submit_local_4worker_secs"] = round(dt, 3)
+
+
+def main() -> None:
+    subprocess.run(
+        ["make", "-C", os.path.join(REPO, "native")],
+        check=False, capture_output=True,
+    )
+    with tempfile.TemporaryDirectory() as tmp:
+        for fn in (
+            bench_libsvm,
+            bench_csv_libfm,
+            bench_recordio,
+            bench_sharded_split,
+            bench_submit,
+        ):
+            fn(tmp)
+    for k, v in RESULTS.items():
+        print(f"{k}: {v:,}")
+    with open(os.path.join(REPO, "benchmarks", "RESULTS.json"), "w") as f:
+        json.dump(RESULTS, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
