@@ -1,14 +1,18 @@
 """Benchmark: HIGGS-like libsvm → parse → fixed-shape batches → TPU HBM.
 
 Measures the north-star metric (BASELINE.md): parsed rows/sec staged into
-device memory, end to end (sharded read → native parse fan-out → batcher →
+device memory, end to end (read → fused native parse→dense-batch kernel →
 async device_put). Prints ONE JSON line:
 
     {"metric": "higgs_staged_rows_per_sec", "value": N,
-     "unit": "rows/sec", "vs_baseline": N / 1_000_000}
+     "unit": "rows/sec", "vs_baseline": N / 1_000_000,
+     "f32_rows_per_sec": N, ...}
 
 vs_baseline is against the 1M rows/sec target (the reference publishes no
-numbers of its own — SURVEY §6).
+numbers of its own — SURVEY §6). The headline number stages feature values
+as float16 (halves infeed DMA; labels/weights stay f32); the float32
+number is reported alongside so dtype choices stay visible round over
+round.
 
 Run on the TPU host as-is (default jax device). Synthetic data is cached
 under /tmp between runs. Use BENCH_ROWS / BENCH_EPOCHS to resize.
@@ -37,12 +41,30 @@ DATA = os.environ.get(
 
 
 def ensure_native() -> None:
-    so = os.path.join(REPO, "native", "libdmlc_tpu_native.so")
-    if not os.path.exists(so):
-        subprocess.run(
+    """Build/refresh the native core. An unusable native library is a
+    bench failure, not a silent 5x-slower fallback (VERDICT r1 weak #3);
+    a failed *build* is tolerated when a working prebuilt .so loads."""
+    build_err = None
+    try:
+        proc = subprocess.run(
             ["make", "-C", os.path.join(REPO, "native")],
-            check=False,
             capture_output=True,
+            text=True,
+        )
+        if proc.returncode != 0:
+            build_err = proc.stdout + proc.stderr
+    except OSError as e:  # no make on this host
+        build_err = str(e)
+    from dmlc_core_tpu.data import native
+
+    if not native.load():
+        if build_err:
+            sys.stderr.write(build_err + "\n")
+        raise RuntimeError("native library unavailable (build log above)")
+    if build_err:
+        sys.stderr.write(
+            "warning: native rebuild failed; benchmarking the prebuilt "
+            "library\n"
         )
 
 
@@ -67,23 +89,19 @@ def ensure_data() -> None:
     os.replace(tmp, DATA)
 
 
-def run_epoch() -> dict:
+def run_epoch(value_dtype: str) -> dict:
     import jax
 
-    from dmlc_core_tpu import data as D
-    from dmlc_core_tpu.staging import BatchSpec, FixedShapeBatcher, StagingPipeline
+    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, dense_batches
 
-    nthread = min(16, os.cpu_count() or 1)
-    parser = D.create_parser(DATA, type="libsvm", nthread=nthread)
     spec = BatchSpec(
         batch_size=BATCH,
         layout="dense",
         num_features=N_FEATURES + 1,
-        # half-precision staging halves host->HBM DMA; compute upcasts
-        value_dtype=np.dtype(os.environ.get("BENCH_DTYPE", "float16")),
+        value_dtype=np.dtype(value_dtype),
     )
-    batcher = FixedShapeBatcher(spec)
-    pipe = StagingPipeline(batcher.batches(iter(parser)), depth=2)
+    stream = dense_batches(DATA, spec)
+    pipe = StagingPipeline(stream, depth=2)
     t0 = time.perf_counter()
     last = None
     for dev in pipe:
@@ -91,7 +109,8 @@ def run_epoch() -> dict:
     if last is not None:
         jax.block_until_ready(last["x"])
     dt = time.perf_counter() - t0
-    parser.close()
+    if hasattr(stream, "close"):
+        stream.close()
     pipe.close()
     return {
         "rows": pipe.rows_staged,
@@ -101,15 +120,20 @@ def run_epoch() -> dict:
     }
 
 
+def best_of(n: int, value_dtype: str) -> float:
+    best = 0.0
+    for _ in range(n):
+        best = max(best, run_epoch(value_dtype)["rows_per_sec"])
+    return best
+
+
 def main() -> None:
     ensure_native()
     ensure_data()
-    best = None
-    for _ in range(EPOCHS):
-        stats = run_epoch()
-        if best is None or stats["rows_per_sec"] > best["rows_per_sec"]:
-            best = stats
-    value = round(best["rows_per_sec"], 1)
+    from dmlc_core_tpu.data import native
+
+    value = round(best_of(EPOCHS, "float16"), 1)
+    f32 = round(best_of(max(1, EPOCHS - 1), "float32"), 1)
     print(
         json.dumps(
             {
@@ -117,6 +141,10 @@ def main() -> None:
                 "value": value,
                 "unit": "rows/sec",
                 "vs_baseline": round(value / 1_000_000, 4),
+                "f32_rows_per_sec": f32,
+                "native": native.AVAILABLE,
+                "fused_dense_kernel": native.HAS_DENSE,
+                "host_cpus": os.cpu_count(),
             }
         )
     )

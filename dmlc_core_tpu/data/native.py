@@ -22,9 +22,18 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["AVAILABLE", "parse_libsvm", "parse_csv", "parse_libfm", "load"]
+__all__ = [
+    "AVAILABLE",
+    "HAS_DENSE",
+    "parse_libsvm",
+    "parse_csv",
+    "parse_libfm",
+    "parse_libsvm_dense",
+    "load",
+]
 
 AVAILABLE = False
+HAS_DENSE = False  # fused libsvm->dense-batch kernel present in the .so
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -56,9 +65,20 @@ class _ParseResult(ctypes.Structure):
     ]
 
 
+class _DenseResult(ctypes.Structure):
+    """Mirrors native/fastparse.cc struct DenseResult."""
+
+    _fields_ = [
+        ("rows_written", ctypes.c_int64),
+        ("bytes_consumed", ctypes.c_int64),
+        ("truncated", ctypes.c_int64),
+        ("has_cr", ctypes.c_int64),
+    ]
+
+
 def load(path: Optional[str] = None) -> bool:
     """Load the native library (idempotent). Returns availability."""
-    global AVAILABLE, _LIB
+    global AVAILABLE, HAS_DENSE, _LIB
     with _LOCK:
         if _LIB is not None:
             return AVAILABLE
@@ -83,10 +103,34 @@ def load(path: Optional[str] = None) -> bool:
                 ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
             lib.dmlc_free_result.argtypes = [ctypes.POINTER(_ParseResult)]
             lib.dmlc_free_result.restype = None
+            # fused dense kernel: absent in older builds of the .so
+            if hasattr(lib, "dmlc_parse_libsvm_dense"):
+                lib.dmlc_parse_libsvm_dense.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.c_int32,
+                    ctypes.POINTER(_DenseResult)]
+                lib.dmlc_parse_libsvm_dense.restype = None
+                HAS_DENSE = True
             _LIB = lib
             AVAILABLE = True
             return True
         return False
+
+
+def _memmove_out(ptr, n: int, dtype) -> np.ndarray:
+    """Copy n elements from a native pointer into a fresh numpy array.
+
+    ctypes.memmove is a plain memcpy; the np.ctypeslib.as_array route used
+    previously built a ctypes array *type* per call, which cost more than
+    the copy itself on large chunks.
+    """
+    arr = np.empty(n, dtype=dtype)
+    if n:
+        ctypes.memmove(arr.ctypes.data, ctypes.cast(ptr, ctypes.c_void_p),
+                       n * arr.itemsize)
+    return arr
 
 
 def _copy_out(res_ptr):
@@ -98,28 +142,13 @@ def _copy_out(res_ptr):
 
             raise Error(res.error.decode())
         n, m = res.n_rows, res.n_elems
-        offset = np.ctypeslib.as_array(res.offset, (n + 1,)).copy()
-        label = np.ctypeslib.as_array(res.label, (n,)).copy() if n else np.empty(0, np.float32)
-        weight = (
-            np.ctypeslib.as_array(res.weight, (n,)).copy()
-            if res.has_weight and n else None
-        )
-        qid = (
-            np.ctypeslib.as_array(res.qid, (n,)).copy()
-            if res.has_qid and n else None
-        )
-        field = (
-            np.ctypeslib.as_array(res.field, (m,)).copy()
-            if res.has_field and m else (np.empty(0, np.int64) if res.has_field else None)
-        )
-        index = (
-            np.ctypeslib.as_array(res.index, (m,)).copy()
-            if m else np.empty(0, np.uint64)
-        )
-        value = (
-            np.ctypeslib.as_array(res.value, (m,)).copy()
-            if res.has_value and m else (np.empty(0, np.float32) if res.has_value else None)
-        )
+        offset = _memmove_out(res.offset, n + 1, np.int64)
+        label = _memmove_out(res.label, n, np.float32)
+        weight = _memmove_out(res.weight, n, np.float32) if res.has_weight else None
+        qid = _memmove_out(res.qid, n, np.int64) if res.has_qid else None
+        field = _memmove_out(res.field, m, np.int64) if res.has_field else None
+        index = _memmove_out(res.index, m, np.uint64)
+        value = _memmove_out(res.value, m, np.float32) if res.has_value else None
         return offset, label, weight, qid, field, index, value
     finally:
         _LIB.dmlc_free_result(res_ptr)
@@ -150,6 +179,59 @@ def parse_libfm(data: bytes, indexing_mode: int):
     res = _LIB.dmlc_parse_libfm(data, len(data), indexing_mode)
     offset, label, weight, _qid, field, index, value = _copy_out(res)
     return offset, label, weight, field, index, value
+
+
+def parse_libsvm_dense(
+    chunk,
+    offset: int,
+    base: int,
+    x: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+    cr_hint: int = -1,
+) -> Optional[Tuple[int, int, int, int]]:
+    """Fused libsvm parse → dense batch rows, zero-copy in and out.
+
+    Parses ``chunk[offset:]`` (bytes/bytearray/memoryview, not sliced — the
+    native side receives a pointer at the offset) into rows
+    ``row_start..`` of the caller-owned buffers:
+
+    - ``x``: C-contiguous [capacity, D] float32 or float16
+    - ``labels``/``weights``: float32 [capacity]
+
+    ``base`` is the resolved indexing base (0 or 1 — subtracted from every
+    parsed feature id; callers resolve the libsvm auto mode themselves).
+    ``cr_hint``: -1 on the first call for a chunk (the kernel probes for
+    '\\r' once); pass the returned ``has_cr`` on resumed calls for the
+    same chunk so the probe isn't repeated. Stops at buffer-full or
+    chunk-end. Returns (rows_written, bytes_consumed, truncated_features,
+    has_cr), or None if the kernel is missing. The rows written are fully
+    initialized (zeroed before scatter), so ring buffers can be reused
+    without clearing.
+    """
+    if not HAS_DENSE:
+        return None
+    mem = np.frombuffer(chunk, dtype=np.uint8)  # no copy, works on bytes
+    assert x.flags.c_contiguous and x.dtype in (np.float32, np.float16)
+    assert labels.dtype == np.float32 and weights.dtype == np.float32
+    capacity, D = x.shape
+    res = _DenseResult()
+    _LIB.dmlc_parse_libsvm_dense(
+        ctypes.c_void_p(mem.ctypes.data + offset),
+        ctypes.c_int64(mem.size - offset),
+        ctypes.c_int32(base),
+        ctypes.c_int64(D),
+        ctypes.c_int32(1 if x.dtype == np.float16 else 0),
+        ctypes.c_void_p(x.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.c_int32(cr_hint),
+        ctypes.byref(res),
+    )
+    return res.rows_written, res.bytes_consumed, res.truncated, res.has_cr
 
 
 load()

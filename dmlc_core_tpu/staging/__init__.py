@@ -6,12 +6,15 @@ bounded in-flight depth, optionally sharded over a jax Mesh data axis.
 """
 
 from .batcher import Batch, BatchSpec, FixedShapeBatcher
+from .fused import FusedDenseLibSVMBatches, dense_batches
 from .pipeline import StagingPipeline, stage_batch
 
 __all__ = [
     "Batch",
     "BatchSpec",
     "FixedShapeBatcher",
+    "FusedDenseLibSVMBatches",
     "StagingPipeline",
+    "dense_batches",
     "stage_batch",
 ]

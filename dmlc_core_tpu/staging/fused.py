@@ -1,0 +1,286 @@
+"""Fused native staging: libsvm text chunks → fixed-shape dense batches.
+
+The single-pass hot path for the north-star metric (BASELINE.md ≥1M rows/s
+into HBM). Where the generic path materializes CSR RowBlocks and re-shapes
+them in Python (parser → RowBlock → FixedShapeBatcher), this hands each
+~8MB chunk straight to the native kernel (native/fastparse.cc
+dmlc_parse_libsvm_dense), which parses text directly into a ring of
+preallocated dense batch buffers — no CSR arrays, no copies, no per-row
+Python. The ring is the reference's recycle-cell discipline
+(threadediter.h:155-172) applied to whole batches.
+
+Semantics match LibSVMParser + FixedShapeBatcher('dense') composed, with
+two documented divergences:
+- libsvm auto indexing (indexing_mode=-1; the default is 0 = keep ids
+  as-is, matching LibSVMParserParam / reference libsvm_parser.h:31) is
+  resolved ONCE by sampling the head of the first chunk (the generic path
+  re-applies the min-index heuristic per chunk slice);
+- qid tokens are consumed but not carried (dense batches have no qid
+  field, same as the generic dense batcher).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..data import native
+from ..io import split as io_split
+from ..io.uri import URISpec
+from ..utils.logging import Error, check
+from .batcher import Batch, BatchSpec
+
+__all__ = ["FusedDenseLibSVMBatches", "dense_batches"]
+
+_BOM = b"\xef\xbb\xbf"
+_MMAP_CHUNK = 32 << 20
+
+
+def _plain_local_path(uri: str) -> Optional[str]:
+    """Path if the URI is a single un-sharded local file, else None."""
+    if any(ch in uri for ch in "?#;*"):
+        return None
+    path = uri[7:] if uri.startswith("file://") else uri
+    if "://" in path:
+        return None
+    return path if os.path.isfile(path) else None
+
+
+class _MmapChunks:
+    """Zero-copy line-aligned chunks over a local file via mmap.
+
+    The kernel reads pages straight from the page cache — no per-chunk
+    bytes allocation or memcpy, which on a single-core TPU host costs as
+    much as the parse itself. Boundary scans use mmap.rfind (C speed).
+    """
+
+    def __init__(self, path: str, chunk_bytes: int = _MMAP_CHUNK) -> None:
+        self._f = open(path, "rb")
+        self._size = os.fstat(self._f.fileno()).st_size
+        self._mm = (
+            mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+            if self._size
+            else None
+        )
+        self._chunk = chunk_bytes
+        self._pos = 0
+
+    def next_chunk(self):
+        if self._mm is None or self._pos >= self._size:
+            return None
+        begin = self._pos
+        end = min(begin + self._chunk, self._size)
+        if end < self._size:
+            nl = self._mm.rfind(b"\n", begin, end)
+            if nl < begin:
+                nl = self._mm.find(b"\n", end)
+                end = self._size if nl < 0 else nl + 1
+            else:
+                end = nl + 1
+        self._pos = end
+        return memoryview(self._mm)[begin:end]
+
+    def close(self) -> None:
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # a yielded memoryview is still alive; GC will finish
+            self._mm = None
+        self._f.close()
+
+
+def _probe_base(chunk) -> int:
+    """Resolve the libsvm auto indexing mode from the head of a chunk.
+
+    Reference heuristic (libsvm_parser.h:159-168, à la sklearn): data is
+    1-based iff no 0 feature id appears; sampled over the first ~256KB.
+    """
+    head = bytes(memoryview(chunk)[:262144])
+    min_idx: Optional[int] = None
+    for line in head.splitlines()[:2000]:
+        body = line.split(b"#", 1)[0]
+        toks = body.split()
+        for tok in toks[1:]:
+            if tok.startswith(b"qid:"):
+                continue
+            try:
+                idx = int(tok.split(b":", 1)[0])
+            except ValueError:
+                continue
+            if idx == 0:
+                return 0
+            if min_idx is None or idx < min_idx:
+                min_idx = idx
+    return 1 if (min_idx is not None and min_idx > 0) else 0
+
+
+class FusedDenseLibSVMBatches:
+    """Iterator of dense Batches over a libsvm URI via the fused kernel.
+
+    Yields Batch views into a ring of ``ring`` preallocated buffer sets;
+    a yielded batch stays valid until ``ring - 1`` further batches have
+    been produced (size the ring above the staging pipeline's
+    prefetch + in-flight depth; the default 8 covers StagingPipeline's
+    defaults with margin).
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        indexing_mode: int = 0,
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_DENSE, "native fused kernel not loaded")
+        check(spec.layout == "dense", "fused path requires layout='dense'")
+        check(spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16)),
+              f"fused path supports f32/f16 values, not {spec.value_dtype}")
+        self.spec = spec
+        uspec = URISpec(uri, part_index, num_parts)
+        if "indexing_mode" in uspec.args:
+            # per-dataset options ride the URI (reference uri_spec.h), same
+            # as the generic LibSVMParser path
+            indexing_mode = int(uspec.args["indexing_mode"])
+        self._indexing_mode = indexing_mode
+        local = _plain_local_path(uspec.uri) if num_parts == 1 else None
+        self._split = (
+            _MmapChunks(local)
+            if local is not None
+            else io_split.create(uspec.uri, part_index, num_parts, type="text")
+        )
+        B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
+        self._ring: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = [
+            (
+                np.zeros((B, D), dtype=spec.value_dtype),
+                np.zeros(B, dtype=np.float32),
+                np.zeros(B, dtype=np.float32),
+            )
+            for _ in range(max(2, ring))
+        ]
+        self._slot = 0
+        self.rows_in = 0
+        self.rows_out = 0
+        self.truncated_nnz = 0
+
+    def _emit(self, x, labels, weights, n_valid: int) -> Batch:
+        self.rows_out += n_valid
+        if self.spec.overflow == "error" and self.truncated_nnz:
+            raise Error(
+                f"{self.truncated_nnz} features outside [0, "
+                f"{self.spec.num_features}) with overflow='error'"
+            )
+        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x)
+
+    def __iter__(self) -> Iterator[Batch]:
+        B = self.spec.batch_size
+        base: Optional[int] = (
+            None if self._indexing_mode < 0
+            else (1 if self._indexing_mode > 0 else 0)
+        )
+        x, labels, weights = self._ring[self._slot]
+        fill = 0
+        first = True
+        while True:
+            chunk = self._split.next_chunk()
+            if chunk is None:
+                break
+            off = 0
+            if first:
+                if bytes(memoryview(chunk)[:3]) == _BOM:
+                    off = 3  # UTF-8 BOM skip (text_parser.h:81-95)
+                if base is None:
+                    base = _probe_base(chunk)
+                first = False
+            n = len(chunk)
+            cr_hint = -1  # probe once per chunk, cache across resumed calls
+            while off < n:
+                rows, consumed, trunc, cr_hint = native.parse_libsvm_dense(
+                    chunk, off, base or 0, x, labels, weights, fill, cr_hint
+                )
+                if consumed == 0 and rows == 0:
+                    break  # defensive: no forward progress
+                off += consumed
+                fill += rows
+                self.rows_in += rows
+                self.truncated_nnz += trunc
+                if fill == B:
+                    yield self._emit(x, labels, weights, B)
+                    self._slot = (self._slot + 1) % len(self._ring)
+                    x, labels, weights = self._ring[self._slot]
+                    fill = 0
+        if fill:
+            # zero-pad the tail batch; padding rows carry weight 0
+            x[fill:] = 0
+            labels[fill:] = 0
+            weights[fill:] = 0
+            yield self._emit(x, labels, weights, fill)
+            self._slot = (self._slot + 1) % len(self._ring)
+
+    def close(self) -> None:
+        self._split.close()
+
+
+class _GenericDenseStream:
+    """Fallback dense Batch stream: generic parser → FixedShapeBatcher.
+
+    Same iterate/close surface as FusedDenseLibSVMBatches, so callers can
+    always close the underlying parser (parse-ahead thread + input file).
+    """
+
+    def __init__(self, parser, batcher) -> None:
+        self._parser = parser
+        self._batcher = batcher
+
+    @property
+    def truncated_nnz(self) -> int:
+        return self._batcher.truncated_nnz
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self._batcher.batches(iter(self._parser))
+
+    def close(self) -> None:
+        self._parser.close()
+
+
+def dense_batches(
+    uri: str,
+    spec: BatchSpec,
+    part_index: int = 0,
+    num_parts: int = 1,
+    nthread: Optional[int] = None,
+    indexing_mode: int = 0,
+    ring: int = 8,
+):
+    """Best-available dense Batch stream for a libsvm URI.
+
+    Uses the fused native kernel when loaded, otherwise the generic
+    parser → FixedShapeBatcher path with the same semantics (including
+    ``indexing_mode``, whether passed here or as ``?indexing_mode=`` on
+    the URI). Either way the result is iterable and has ``.close()``.
+    """
+    if native.HAS_DENSE and spec.layout == "dense" and spec.value_dtype in (
+        np.dtype(np.float32), np.dtype(np.float16)
+    ):
+        return FusedDenseLibSVMBatches(
+            uri, spec, part_index, num_parts, indexing_mode, ring
+        )
+    from ..data import create_parser
+    from .batcher import FixedShapeBatcher
+
+    uspec = URISpec(uri, part_index, num_parts)
+    if "indexing_mode" not in uspec.args and indexing_mode != 0:
+        sep = "?" if "?" not in uri.split("#", 1)[0] else "&"
+        head, _, frag = uri.partition("#")
+        uri = f"{head}{sep}indexing_mode={indexing_mode}" + (
+            f"#{frag}" if frag else ""
+        )
+    parser = create_parser(
+        uri, part_index, num_parts, type="libsvm", nthread=nthread
+    )
+    return _GenericDenseStream(parser, FixedShapeBatcher(spec))

@@ -37,6 +37,21 @@ def _require_jax():
     return jax
 
 
+def _safe_host(v: np.ndarray, platform: str) -> np.ndarray:
+    """Defend against CPU-backend zero-copy aliasing of host buffers.
+
+    jax's CPU client may adopt a suitably-aligned numpy buffer zero-copy
+    in device_put; producers that recycle a ring of host buffers
+    (staging/fused.py) would then mutate the "device" array in place. On
+    CPU backends we copy first (alignment — and therefore aliasing — is
+    allocation-dependent, so this must be unconditional). Real accelerator
+    backends copy to device memory during the transfer; no copy needed.
+    """
+    if platform == "cpu":
+        return np.array(v, copy=True)
+    return v
+
+
 def stage_batch(
     batch: Batch,
     device=None,
@@ -55,8 +70,10 @@ def stage_batch(
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
+        platform = mesh.devices.flat[0].platform
         out = {}
         for k, v in arrays.items():
+            v = _safe_host(v, platform)
             spec = PartitionSpec(data_axis, *([None] * (v.ndim - 1)))
             sharding = NamedSharding(mesh, spec)
             if jax.process_count() > 1:
@@ -66,7 +83,10 @@ def stage_batch(
         return out
     if device is None:
         device = jax.local_devices()[0]
-    return {k: jax.device_put(v, device) for k, v in arrays.items()}
+    return {
+        k: jax.device_put(_safe_host(v, device.platform), device)
+        for k, v in arrays.items()
+    }
 
 
 class StagingPipeline:
@@ -121,7 +141,16 @@ class StagingPipeline:
                 inflight.append(dev)
             if not inflight:
                 return
-            yield inflight.popleft()
+            dev = inflight.popleft()
+            # Force this batch's transfer to complete before handing it
+            # out. Transfers for the batches still in `inflight` proceed
+            # concurrently (that's the overlap); what this guarantees is a
+            # bound on host-buffer lifetime, so producers that recycle a
+            # ring of host buffers (staging/fused.py) can size the ring as
+            # prefetch + depth + consumer instead of "unbounded, because
+            # async dispatch may read the host buffer arbitrarily late".
+            self._jax.block_until_ready(dev)
+            yield dev
 
     def throughput(self) -> Dict[str, float]:
         """rows/sec and MB/sec since first iteration (SURVEY §5.1 metric

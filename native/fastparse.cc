@@ -20,6 +20,10 @@
 #include <string>
 #include <vector>
 
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
 #define DMLC_API extern "C" __attribute__((visibility("default")))
 
 namespace {
@@ -494,4 +498,292 @@ DMLC_API ParseResult* dmlc_parse_libfm(const char* buf, int64_t len,
 
 DMLC_API void dmlc_free_result(ParseResult* r) {
   delete reinterpret_cast<Holder*>(r);
+}
+
+// -- fused libsvm -> fixed-shape dense batch ---------------------------------
+//
+// The TPU-specific hot path (SURVEY §7 step 4/5): parses libsvm text straight
+// into a caller-provided dense [capacity, D] batch buffer (float32 or
+// float16), labels and weights included — no CSR materialization, no
+// intermediate copies, no per-row Python. The caller owns a ring of reusable
+// batch buffers (reference recycle-cell discipline, threadediter.h:155-172)
+// and calls this repeatedly with (row_start, remaining chunk bytes); the
+// kernel stops at buffer-full or chunk-end and reports bytes consumed so the
+// next call resumes mid-chunk.
+//
+// Semantics match dmlc_parse_libsvm + FixedShapeBatcher(dense) composed
+// (parity enforced by tests/test_native.py): line skipped iff its label token
+// fails to parse; '#' starts a comment; first token may be label:weight; a
+// second token 'qid:N' is consumed and discarded (dense batches carry no
+// qid); features with (index - base) outside [0, D) are counted in
+// `truncated` and dropped; duplicate in-range indices accumulate.
+
+namespace {
+
+// float32 -> float16 bits (IEEE 754 half, round-to-nearest-even)
+inline uint16_t f32_to_f16(float f) {
+#if defined(__F16C__)
+  return static_cast<uint16_t>(
+      _cvtss_sh(f, _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC));
+#else
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  const uint32_t sign = (x >> 16) & 0x8000u;
+  x &= 0x7fffffffu;
+  if (x > 0x7f800000u) return static_cast<uint16_t>(sign | 0x7e00u);  // nan
+  if (x >= 0x47800000u) return static_cast<uint16_t>(sign | 0x7c00u);
+  if (x < 0x38800000u) {  // subnormal half (or zero)
+    // half = RNE(mant24 * 2^(e-126)); values <= 2^-25 round to 0
+    if (x <= 0x33000000u) return static_cast<uint16_t>(sign);
+    const int e = static_cast<int>(x >> 23);
+    const int shift = 126 - e;  // in [14, 24]
+    const uint32_t mant = (x & 0x7fffffu) | 0x800000u;
+    uint32_t q = mant >> shift;
+    const uint32_t rem = mant & ((1u << shift) - 1u);
+    const uint32_t halfbit = 1u << (shift - 1);
+    if (rem > halfbit || (rem == halfbit && (q & 1u))) ++q;
+    return static_cast<uint16_t>(sign | q);
+  }
+  // normal: rebias exponent, round the 13 dropped mantissa bits (RNE);
+  // a mantissa carry correctly bumps the exponent, incl. 65520 -> inf
+  uint32_t half = (x - 0x38000000u) >> 13;
+  const uint32_t drop = x & 0x1fffu;
+  if (drop > 0x1000u || (drop == 0x1000u && (half & 1u))) ++half;
+  return static_cast<uint16_t>(sign | half);
+#endif
+}
+
+struct DenseState {
+  void* x;         // [capacity, D] f32 or f16
+  float* labels;   // [capacity]
+  float* weights;  // [capacity]
+  float* scratch;  // [D] f32 accumulation row (L1-resident)
+  int64_t D;
+  bool f16;
+  int64_t base;  // subtract from parsed feature index (0 or 1)
+  int64_t truncated;
+};
+
+// Features accumulate into the f32 scratch row; the completed row is then
+// converted/copied into the output in one vectorized pass. (For f16 output
+// this means duplicate feature ids accumulate at f32 precision with a
+// single final round — at least as accurate as numpy's per-step f16
+// add.at, identical whenever a row has no duplicate ids.)
+inline void row_flush(DenseState& st, int64_t row) {
+  if (st.f16) {
+    uint16_t* dst = static_cast<uint16_t*>(st.x) + row * st.D;
+    int64_t i = 0;
+#if defined(__F16C__) && defined(__AVX__)
+    for (; i + 8 <= st.D; i += 8) {
+      const __m256 v = _mm256_loadu_ps(st.scratch + i);
+      _mm_storeu_si128(
+          reinterpret_cast<__m128i*>(dst + i),
+          _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC));
+    }
+#endif
+    for (; i < st.D; ++i) dst[i] = f32_to_f16(st.scratch[i]);
+  } else {
+    std::memcpy(static_cast<float*>(st.x) + row * st.D, st.scratch,
+                static_cast<size_t>(st.D) * 4);
+  }
+}
+
+// Parse one libsvm line into dense row `row`. Returns true iff the line
+// produced a row (valid label). Zeroes the row before writing.
+inline bool parse_dense_line(const char* lb, const char* le, DenseState& st,
+                             int64_t row) {
+  const void* hash = memchr(lb, '#', static_cast<size_t>(le - lb));
+  if (hash) le = static_cast<const char*>(hash);
+
+  // ---- label token ----
+  const char* p = lb;
+  while (p < le && is_blank(*p)) ++p;
+  if (p >= le) return false;
+  const char* te = p;
+  while (te < le && !is_blank(*te)) ++te;
+  {
+    const char* colon =
+        static_cast<const char*>(memchr(p, ':', static_cast<size_t>(te - p)));
+    double lab, w = 1.0;
+    if (colon) {
+      if (!parse_float_full(p, colon, &lab) ||
+          !parse_float_full(colon + 1, te, &w))
+        return false;
+    } else if (!parse_float_full(p, te, &lab)) {
+      return false;
+    }
+    st.labels[row] = static_cast<float>(lab);
+    st.weights[row] = static_cast<float>(w);
+  }
+  p = te;
+
+  // row accepted: features accumulate in the zeroed scratch row, flushed
+  // to the (possibly dirty, ring-reused) output row at the end
+  std::memset(st.scratch, 0, static_cast<size_t>(st.D) * 4);
+
+  // ---- optional qid token (second token only; consumed, not stored) ----
+  while (p < le && is_blank(*p)) ++p;
+  {
+    const char* qe = p;
+    while (qe < le && !is_blank(*qe)) ++qe;
+    if (qe - p >= 4 && memcmp(p, "qid:", 4) == 0) p = qe;
+  }
+
+  // ---- feature tokens: same fused fast path as dmlc_parse_libsvm ----
+  const uint64_t ubase = static_cast<uint64_t>(st.base);
+  const uint64_t uD = static_cast<uint64_t>(st.D);
+  while (p < le) {
+    while (p < le && is_blank(*p)) ++p;
+    if (p >= le) break;
+    const char* q = p;
+    uint64_t feat = 0;
+    int fd = 0;
+    while (q < le && *q >= '0' && *q <= '9' && fd <= 18) {
+      feat = feat * 10 + static_cast<uint64_t>(*q - '0');
+      ++q;
+      ++fd;
+    }
+    if (fd > 0 && fd <= 18) {
+      if (q >= le || is_blank(*q)) {
+        // bare integer feature (binary, value 1)
+        const uint64_t col = feat - ubase;  // wraps huge if feat < base
+        if (col < uD) {
+          st.scratch[col] += 1.0f;
+        } else {
+          ++st.truncated;
+        }
+        p = q;
+        continue;
+      }
+      if (*q == ':') {
+        ++q;
+        bool neg = false;
+        if (q < le && *q == '-') {
+          neg = true;
+          ++q;
+        }
+        uint64_t mant = 0;
+        int digits = 0, frac = 0;
+        bool dot = false, fok = true, any = false;
+        for (; q < le; ++q) {
+          const char c = *q;
+          if (c >= '0' && c <= '9') {
+            if (++digits > 15) {
+              fok = false;
+              break;
+            }
+            mant = mant * 10 + static_cast<uint64_t>(c - '0');
+            any = true;
+            if (dot) ++frac;
+          } else if (c == '.' && !dot) {
+            dot = true;
+          } else {
+            break;
+          }
+        }
+        if (fok && any && (q >= le || is_blank(*q))) {
+          const double v = static_cast<double>(mant) / kPow10[frac];
+          const uint64_t col = feat - ubase;
+          if (col < uD) {
+            st.scratch[col] += static_cast<float>(neg ? -v : v);
+          } else {
+            ++st.truncated;
+          }
+          p = q;
+          continue;
+        }
+      }
+    }
+    // slow path: exact token-level parse over the full token
+    te = p;
+    while (te < le && !is_blank(*te)) ++te;
+    const char* colon =
+        static_cast<const char*>(memchr(p, ':', static_cast<size_t>(te - p)));
+    int64_t sfeat;
+    if (colon) {
+      double v;
+      if (parse_i64_full(p, colon, &sfeat) &&
+          parse_float_full(colon + 1, te, &v)) {
+        const uint64_t col = static_cast<uint64_t>(sfeat) - ubase;
+        if (col < uD) {
+          st.scratch[col] += static_cast<float>(v);
+        } else {
+          ++st.truncated;
+        }
+      }
+    } else if (parse_i64_full(p, te, &sfeat)) {
+      const uint64_t col = static_cast<uint64_t>(sfeat) - ubase;
+      if (col < uD) {
+        st.scratch[col] += 1.0f;
+      } else {
+        ++st.truncated;
+      }
+    }
+    p = te;
+  }
+  row_flush(st, row);
+  return true;
+}
+
+}  // namespace
+
+// Out-params mirror _DenseResult in dmlc_core_tpu/data/native.py.
+struct DenseResult {
+  int64_t rows_written;
+  int64_t bytes_consumed;
+  int64_t truncated;
+  int64_t has_cr;  // echo of the '\r' probe so callers can cache it
+};
+
+// cr_hint: -1 = unknown (probe the remaining buffer once — callers cache
+// the echoed result across resumed calls on the same chunk), 0 = no '\r'
+// anywhere in the chunk, 1 = may contain '\r'.
+DMLC_API void dmlc_parse_libsvm_dense(
+    const char* buf, int64_t len, int32_t base, int64_t num_features,
+    int32_t out_f16, void* x, float* labels, float* weights,
+    int64_t row_start, int64_t row_capacity, int32_t cr_hint,
+    DenseResult* out) {
+  std::vector<float> scratch(static_cast<size_t>(num_features));
+  DenseState st{x,
+                labels,
+                weights,
+                scratch.data(),
+                num_features,
+                out_f16 != 0,
+                static_cast<int64_t>(base),
+                0};
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = row_start;
+  // one SIMD scan (per chunk, cached by the caller via the hint) decides
+  // whether per-line '\r' handling is needed at all
+  const bool has_cr =
+      cr_hint < 0 ? memchr(buf, '\r', static_cast<size_t>(len)) != nullptr
+                  : cr_hint != 0;
+  while (p < end && row < row_capacity) {
+    // line ends at '\n', '\r', or "\r\n" (Python splitlines semantics);
+    // memchr keeps the scan SIMD-fast on the common '\n'-only data
+    const char* nl =
+        static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* seg_end = nl ? nl : end;
+    const char* cr =
+        has_cr ? static_cast<const char*>(
+                     memchr(p, '\r', static_cast<size_t>(seg_end - p)))
+               : nullptr;
+    const char* line_end;
+    const char* next;
+    if (cr) {
+      line_end = cr;
+      next = (cr + 1 == nl) ? nl + 1 : cr + 1;
+    } else {
+      line_end = seg_end;
+      next = nl ? nl + 1 : end;
+    }
+    if (parse_dense_line(p, line_end, st, row)) ++row;
+    p = next;
+  }
+  out->rows_written = row - row_start;
+  out->bytes_consumed = p - buf;
+  out->truncated = st.truncated;
+  out->has_cr = has_cr ? 1 : 0;
 }
