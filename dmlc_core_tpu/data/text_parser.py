@@ -26,13 +26,23 @@ _BOM = b"\xef\xbb\xbf"
 
 
 def default_parser_threads(nthread: Optional[int]) -> int:
-    """Reference heuristic (text_parser.h:33-34):
-    min(requested, max(procs/2 - 4, 1)); requested defaults to 2
-    (src/data.cc:29)."""
-    if nthread is None:
-        nthread = 2
+    """Parser fan-out width.
+
+    Deliberate divergence from the reference heuristic
+    min(requested, max(procs/2 - 4, 1)) (text_parser.h:33-34, default 2
+    from data.cc:29): that throttle assumes the learner competes for host
+    CPU, but on a TPU host the CPU idles during the device step, so the
+    parser gets every core by default. Requests are still capped at the
+    core count (extra threads only add GIL churn), and
+    DMLC_TPU_PARSER_THREADS overrides both.
+    """
+    env = os.environ.get("DMLC_TPU_PARSER_THREADS")
+    if env:
+        return max(1, int(env))
     procs = os.cpu_count() or 1
-    return max(1, min(nthread, max(procs // 2 - 4, 1)))
+    if nthread is None:
+        return procs
+    return max(1, min(nthread, procs))
 
 
 class TextParserBase(Parser):

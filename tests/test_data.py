@@ -361,3 +361,16 @@ def test_threaded_iter_before_first_raises_pending_error():
     time.sleep(0.1)  # let the producer hit the failure
     with pytest.raises(RuntimeError, match="transient failure"):
         it.before_first()
+
+
+def test_default_parser_threads_tpu_host_policy(monkeypatch):
+    """TPU-host divergence: no procs//2-4 throttle; env var overrides."""
+    from dmlc_core_tpu.data.text_parser import default_parser_threads
+
+    monkeypatch.setattr("os.cpu_count", lambda: 8)
+    assert default_parser_threads(None) == 8  # all cores by default
+    assert default_parser_threads(16) == 8  # capped at core count
+    assert default_parser_threads(3) == 3
+    monkeypatch.setenv("DMLC_TPU_PARSER_THREADS", "5")
+    assert default_parser_threads(None) == 5
+    assert default_parser_threads(2) == 5  # env wins
