@@ -39,8 +39,15 @@ class FramedSocket:
     def send_int(self, value: int) -> None:
         self.sock.sendall(struct.pack("<i", value))
 
+    #: strings on this protocol are hostnames/jobids/log lines — anything
+    #: beyond this is a hostile or corrupt frame, not a real message
+    MAX_STR = 1 << 20
+
     def recv_str(self) -> str:
-        return self.recv_all(self.recv_int()).decode()
+        n = self.recv_int()
+        if not 0 <= n <= self.MAX_STR:
+            raise ConnectionError(f"invalid string length {n} on the wire")
+        return self.recv_all(n).decode()
 
     def send_str(self, value: str) -> None:
         data = value.encode()

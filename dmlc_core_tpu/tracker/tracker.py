@@ -121,7 +121,13 @@ class WorkerEntry:
         while True:
             ngood = self.sock.recv_int()
             goodset = {self.sock.recv_int() for _ in range(ngood)}
-            assert goodset.issubset(nnset), (goodset, nnset)
+            if not goodset.issubset(nnset):
+                # client-controlled field: never assert (the reference
+                # asserts and kills its accept thread here)
+                raise ProtocolError(
+                    f"rank {rank} reported links {sorted(goodset - nnset)} "
+                    f"outside its neighbor set {sorted(nnset)}"
+                )
             badset = nnset - goodset
             conset = [r for r in badset if r in wait_conn]
             self.sock.send_int(len(conset))
@@ -154,7 +160,15 @@ class RabitTracker:
         n_workers: int,
         port: int = 9091,
         port_end: int = 9999,
+        client_timeout: float = 60.0,
     ) -> None:
+        #: per-socket recv/send deadline: a stalling (slow-loris) client
+        #: must not wedge the single-threaded accept loop. Timeouts raise
+        #: socket.timeout (an OSError), which the accept loop treats like
+        #: any dead connection. The protocol has no auth (as upstream rabit):
+        #: a client that *completes* frames can still lie about identity;
+        #: the tracker only defends liveness + state consistency.
+        self.client_timeout = client_timeout
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         bound = None
@@ -204,6 +218,7 @@ class RabitTracker:
 
         while len(shutdown) != n_workers:
             conn, addr = self.sock.accept()
+            conn.settimeout(self.client_timeout)
             try:
                 entry = WorkerEntry(conn, addr)
             except (ConnectionError, OSError) as e:
@@ -263,40 +278,81 @@ class RabitTracker:
                 check_proto(
                     rank < n_workers, f"rank {rank} out of range"
                 )
+                if rank != -1:
+                    # consistency with the jobid→rank memo: a client naming
+                    # an in-range rank must not contradict (or hijack) a
+                    # rank the memo says belongs to another job id
+                    check_proto(
+                        job_map.get(entry.jobid, rank) == rank,
+                        f"jobid {entry.jobid!r} previously held rank "
+                        f"{job_map.get(entry.jobid)}, not {rank}",
+                    )
+                    owner = next(
+                        (j for j, r in job_map.items() if r == rank), None
+                    )
+                    check_proto(
+                        owner is None or owner == entry.jobid,
+                        f"rank {rank} belongs to jobid {owner!r}, "
+                        f"not {entry.jobid!r}",
+                    )
                 if rank == -1:
                     check_proto(bool(todo_nodes), "no free rank left")
                     pending.append(entry)
-                    if len(pending) == len(todo_nodes):
-                        # batch assignment sorted by host for locality
-                        # (reference accept_slaves, tracker.py:293-311)
-                        pending.sort(key=lambda e: e.host)
-                        for entry in pending:
-                            rank = todo_nodes.pop(0)
-                            if entry.jobid != "NULL":
-                                job_map[entry.jobid] = rank
-                            entry.assign_rank(
-                                rank, wait_conn, tree_map, parent_map,
-                                ring_map,
-                            )
-                            if entry.wait_accept > 0:
-                                wait_conn[rank] = entry
-                            logger.debug(
-                                "%s from %s; assigned rank %d",
-                                entry.cmd, entry.host, entry.rank,
-                            )
-                        pending = []
-                    if not todo_nodes:
-                        logger.info(
-                            "@tracker all of %d nodes are started", n_workers
-                        )
-                        self.start_time = time.time()
                 else:
                     entry.assign_rank(
                         rank, wait_conn, tree_map, parent_map, ring_map
                     )
+                    # a rank reclaimed after dying mid-assignment is no
+                    # longer free. (If the dead worker had already wired
+                    # TCP links to peers, those peers hold dead sockets
+                    # until they notice and re-rendezvous via the recover
+                    # path — same contract as any post-assignment death.)
+                    if rank in todo_nodes:
+                        todo_nodes.remove(rank)
                     logger.debug("%s signal from %d", entry.cmd, entry.rank)
                     if entry.wait_accept > 0:
                         wait_conn[entry.rank] = entry
+                # batch assignment fires when every free rank has a waiting
+                # worker — re-checked after BOTH branches because the else
+                # branch can shrink todo_nodes (reference accept_slaves,
+                # tracker.py:293-311). Sorted by host for locality.
+                # Failure-atomic: each entry is assigned under its own
+                # guard — a worker dying mid-brokering returns its rank to
+                # todo_nodes and must reconnect; the rest of the batch
+                # still gets wired.
+                if pending and len(pending) == len(todo_nodes):
+                    pending.sort(key=lambda e: e.host)
+                    batch, pending = pending, []
+                    for peer in batch:
+                        new_rank = todo_nodes.pop(0)
+                        try:
+                            peer.assign_rank(
+                                new_rank, wait_conn, tree_map,
+                                parent_map, ring_map,
+                            )
+                        except (ProtocolError, ConnectionError,
+                                OSError) as e:
+                            logger.warning(
+                                "assigning rank %d to %s failed: %s — "
+                                "rank returned to pool",
+                                new_rank, peer.host, e,
+                            )
+                            peer.sock.close()
+                            todo_nodes.insert(0, new_rank)
+                            continue
+                        if peer.jobid != "NULL":
+                            job_map[peer.jobid] = new_rank
+                        if peer.wait_accept > 0:
+                            wait_conn[new_rank] = peer
+                        logger.debug(
+                            "%s from %s; assigned rank %d",
+                            peer.cmd, peer.host, peer.rank,
+                        )
+                if not todo_nodes and self.start_time is None:
+                    logger.info(
+                        "@tracker all of %d nodes are started", n_workers
+                    )
+                    self.start_time = time.time()
             except ProtocolError as e:
                 logger.warning(
                     "protocol error from %s: %s — dropping connection",

@@ -157,6 +157,209 @@ def test_tracker_worker_envs():
     tracker.close()
 
 
+# -- hostile clients: the accept loop must survive and finish the job --------
+
+from dmlc_core_tpu.tracker.protocol import MAGIC, FramedSocket
+
+
+def _raw_conn(port):
+    return socket.create_connection(("127.0.0.1", port), timeout=10)
+
+
+def _handshake(port, rank=-1, world=-1, jobid="NULL", cmd="start"):
+    fs = FramedSocket(_raw_conn(port))
+    fs.send_int(MAGIC)
+    assert fs.recv_int() == MAGIC
+    fs.send_int(rank)
+    fs.send_int(world)
+    fs.send_str(jobid)
+    fs.send_str(cmd)
+    return fs
+
+
+def test_tracker_survives_garbage_and_truncated_clients():
+    """Fuzzed/garbage/truncated connections are dropped; the real job
+    still completes (reference dies on any of these,
+    tracker.py:293-311)."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+
+    # 1. raw garbage bytes (bad magic)
+    c = _raw_conn(tracker.port)
+    c.sendall(b"\xde\xad\xbe\xef" * 4)
+    c.close()
+    # 2. truncated handshake: magic then EOF
+    c = _raw_conn(tracker.port)
+    c.sendall((MAGIC).to_bytes(4, "little"))
+    c.close()
+    # 3. valid framing, unknown command
+    fs = _handshake(tracker.port, cmd="frobnicate")
+    fs.close()
+    # 4. shutdown from an invalid rank
+    fs = _handshake(tracker.port, rank=99, cmd="shutdown")
+    fs.close()
+    # 5. negative string length in the jobid frame
+    c = _raw_conn(tracker.port)
+    c.sendall((MAGIC).to_bytes(4, "little"))
+    c.recv(4)
+    c.sendall((0).to_bytes(4, "little") * 2)
+    c.sendall((-5).to_bytes(4, "little", signed=True))
+    c.close()
+
+    results = run_workers(tracker, 2)
+    tracker.join()
+    tracker.close()
+    assert sorted(r[0] for r in results) == [0, 1]
+
+
+def test_tracker_rejects_goodset_outside_neighbors():
+    """A client reporting links outside its neighbor set is dropped
+    (ProtocolError, not AssertionError), its rank is returned to the
+    pool, and a fresh worker can still claim it."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+
+    fs = _handshake(tracker.port, world=1)
+    rank = fs.recv_int()
+    assert rank == 0
+    fs.recv_int()  # parent
+    fs.recv_int()  # world
+    n_tree = fs.recv_int()
+    for _ in range(n_tree):
+        fs.recv_int()
+    fs.recv_int()  # ring prev
+    fs.recv_int()  # ring next
+    # lie: claim a wired link to rank 77 (not a neighbor)
+    fs.send_int(1)
+    fs.send_int(77)
+    # tracker must drop this connection rather than die
+    fs.sock.settimeout(10)
+    try:
+        data = fs.sock.recv(4)
+    except (ConnectionResetError, OSError):
+        data = b""
+    assert data == b""  # server closed on us
+    fs.close()
+
+    # the leaked rank is reusable: a well-behaved worker finishes the job
+    w = RabitWorker("127.0.0.1", tracker.port, jobid="fresh")
+    assert w.start(world_size=-1) == 0
+    w.shutdown()
+    tracker.join()
+    tracker.close()
+
+
+def test_tracker_batch_survives_death_mid_brokering():
+    """n=2: one client dies right after receiving its rank; the other
+    worker must still be assigned, and a replacement worker claims the
+    leaked rank and wires the peer link (failure-atomic batch)."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+
+    survivor = RabitWorker("127.0.0.1", tracker.port, jobid="good")
+    state = {}
+    t = threading.Thread(
+        target=lambda: state.setdefault("rank", survivor.start(world_size=2))
+    )
+    t.start()
+    time.sleep(0.2)
+    # hostile half of the batch: handshake, then vanish before brokering
+    fs = _handshake(tracker.port, jobid="bad")
+    fs.recv_int()  # rank arrives -> assignment in progress
+    fs.close()
+
+    # survivor gets its rank but blocks waiting for its dead peer;
+    # a replacement worker picks up the leaked rank and wires the link
+    replacement = RabitWorker("127.0.0.1", tracker.port, jobid="bad2")
+    r2 = replacement.start(world_size=-1)
+    t.join(timeout=20)
+    assert not t.is_alive(), "survivor never finished wiring"
+    ranks = {state["rank"], r2}
+    assert ranks == {0, 1}
+    assert r2 in survivor.links and state["rank"] in replacement.links
+    survivor.shutdown()
+    replacement.shutdown()
+    tracker.join()
+    tracker.close()
+
+
+def test_pending_worker_unblocked_by_recover():
+    """The batch trigger must re-fire when a recover shrinks the free-rank
+    pool: two hostile clients leak both ranks, a fresh worker waits in
+    pending, then a recover claims one rank directly — the pending worker
+    must immediately get the other."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+
+    # two hostile clients: handshake, receive rank, vanish → both ranks leak
+    f1 = _handshake(tracker.port, world=2, jobid="h1")
+    f2 = _handshake(tracker.port, jobid="h2")
+    f1.recv_int()
+    f1.close()
+    f2.recv_int()
+    f2.close()
+    time.sleep(0.3)
+
+    fresh = RabitWorker("127.0.0.1", tracker.port, jobid="fresh")
+    state = {}
+    t = threading.Thread(
+        target=lambda: state.setdefault("rank", fresh.start(world_size=-1))
+    )
+    t.start()
+    time.sleep(0.3)  # fresh is parked in pending (1 waiting, 2 free ranks)
+    recoverer = RabitWorker("127.0.0.1", tracker.port, jobid="rec")
+    r_rec = recoverer.start(recover_rank=1)
+    t.join(timeout=20)
+    assert not t.is_alive(), "pending worker was never assigned"
+    assert {state["rank"], r_rec} == {0, 1}
+    fresh.shutdown()
+    recoverer.shutdown()
+    tracker.join()
+    tracker.close()
+
+
+def test_tracker_drops_slow_loris_client():
+    """A client that connects and stalls must be timed out, not allowed
+    to wedge the single-threaded accept loop."""
+    tracker = RabitTracker("127.0.0.1", 2, client_timeout=1.0)
+    tracker.start(2)
+    stall = _raw_conn(tracker.port)  # connects, never sends a byte
+    results = run_workers(tracker, 2)
+    tracker.join()
+    tracker.close()
+    stall.close()
+    assert sorted(r[0] for r in results) == [0, 1]
+
+
+def test_tracker_rejects_rank_hijack():
+    """A hostile client claiming a live worker's rank (with a different
+    jobid) is rejected by the jobid→rank consistency check; the real job
+    completes untouched."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+    w0 = RabitWorker("127.0.0.1", tracker.port, jobid="0")
+    w1 = RabitWorker("127.0.0.1", tracker.port, jobid="1")
+    t1 = threading.Thread(target=lambda: w1.start(world_size=-1))
+    t1.start()
+    r0 = w0.start(world_size=2)
+    t1.join(timeout=15)
+
+    # job is live; attacker claims rank r0 under a foreign jobid
+    fs = _handshake(tracker.port, rank=r0, jobid="evil", cmd="start")
+    fs.sock.settimeout(10)
+    try:
+        data = fs.sock.recv(4)
+    except (ConnectionResetError, OSError):
+        data = b""
+    assert data == b""  # dropped, no rank frame sent
+    fs.close()
+
+    w0.shutdown()
+    w1.shutdown()
+    tracker.join()
+    tracker.close()
+
+
 # -- backends (command builders, no cluster needed) --------------------------
 
 def parse(argv):
