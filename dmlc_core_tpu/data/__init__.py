@@ -18,6 +18,7 @@ from .libsvm_parser import LibSVMParser, LibSVMParserParam
 from .parser import PARSER_REGISTRY, Parser, ThreadedParser
 from .row_block import INDEX_T, REAL_T, Row, RowBlock, RowBlockContainer
 from .row_iter import BasicRowIter, DiskRowIter, RowBlockIter
+from .rowrec import RowRecParser, write_rowrec
 from .text_parser import TextParserBase
 
 __all__ = [
@@ -33,6 +34,8 @@ __all__ = [
     "LibSVMParserParam",
     "CSVParserParam",
     "LibFMParserParam",
+    "RowRecParser",
+    "write_rowrec",
     "RowBlockIter",
     "BasicRowIter",
     "DiskRowIter",
@@ -67,6 +70,16 @@ def _create_csv(uri, args, part_index, num_parts, nthread=None, index_dtype=INDE
 def _create_libfm(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
     return LibFMParser(
         _make_text_source(uri, part_index, num_parts), args, nthread, index_dtype
+    )
+
+
+@PARSER_REGISTRY.register("rowrec")
+def _create_rowrec(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
+    return RowRecParser(
+        io_split.create(uri, part_index, num_parts, type="recordio"),
+        args,
+        nthread,
+        index_dtype,
     )
 
 

@@ -25,15 +25,19 @@ import numpy as np
 __all__ = [
     "AVAILABLE",
     "HAS_DENSE",
+    "HAS_ELL",
     "parse_libsvm",
     "parse_csv",
     "parse_libfm",
     "parse_libsvm_dense",
+    "parse_rowrec_ell",
+    "source_hash",
     "load",
 ]
 
 AVAILABLE = False
 HAS_DENSE = False  # fused libsvm->dense-batch kernel present in the .so
+HAS_ELL = False    # fused recordio rowrec->ELL-batch kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -76,9 +80,20 @@ class _DenseResult(ctypes.Structure):
     ]
 
 
+class _EllResult(ctypes.Structure):
+    """Mirrors native/fastparse.cc struct EllResult."""
+
+    _fields_ = [
+        ("rows_written", ctypes.c_int64),
+        ("bytes_consumed", ctypes.c_int64),
+        ("truncated", ctypes.c_int64),
+        ("bad_records", ctypes.c_int64),
+    ]
+
+
 def load(path: Optional[str] = None) -> bool:
     """Load the native library (idempotent). Returns availability."""
-    global AVAILABLE, HAS_DENSE, _LIB
+    global AVAILABLE, HAS_DENSE, HAS_ELL, _LIB
     with _LOCK:
         if _LIB is not None:
             return AVAILABLE
@@ -113,10 +128,32 @@ def load(path: Optional[str] = None) -> bool:
                     ctypes.POINTER(_DenseResult)]
                 lib.dmlc_parse_libsvm_dense.restype = None
                 HAS_DENSE = True
+            # fused recordio rowrec->ELL kernel: absent in older builds
+            if hasattr(lib, "dmlc_parse_rowrec_ell"):
+                lib.dmlc_parse_rowrec_ell.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_int64,
+                    ctypes.POINTER(_EllResult)]
+                lib.dmlc_parse_rowrec_ell.restype = None
+                HAS_ELL = True
+            if hasattr(lib, "dmlc_source_hash"):
+                lib.dmlc_source_hash.restype = ctypes.c_char_p
+                lib.dmlc_source_hash.argtypes = []
             _LIB = lib
             AVAILABLE = True
             return True
         return False
+
+
+def source_hash() -> str:
+    """sha256 of the fastparse.cc the loaded .so was built from, or ''
+    (older builds). bench.py compares this against the on-disk source so a
+    failed rebuild can't silently benchmark a stale binary."""
+    if not AVAILABLE or not hasattr(_LIB, "dmlc_source_hash"):
+        return ""
+    return _LIB.dmlc_source_hash().decode()
 
 
 def _memmove_out(ptr, n: int, dtype) -> np.ndarray:
@@ -212,10 +249,19 @@ def parse_libsvm_dense(
     """
     if not HAS_DENSE:
         return None
+    from ..utils.logging import check
+
     mem = np.frombuffer(chunk, dtype=np.uint8)  # no copy, works on bytes
-    assert x.flags.c_contiguous and x.dtype in (np.float32, np.float16)
-    assert labels.dtype == np.float32 and weights.dtype == np.float32
+    # memory-safety preconditions: the kernel writes through raw pointers
+    # assuming contiguous f32/f16 layout — never assert (stripped under -O)
+    check(x.flags.c_contiguous and x.dtype in (np.float32, np.float16),
+          "x must be C-contiguous float32/float16")
+    check(labels.flags.c_contiguous and labels.dtype == np.float32
+          and weights.flags.c_contiguous and weights.dtype == np.float32,
+          "labels/weights must be C-contiguous float32")
     capacity, D = x.shape
+    check(len(labels) >= capacity and len(weights) >= capacity,
+          "labels/weights shorter than x capacity")
     res = _DenseResult()
     _LIB.dmlc_parse_libsvm_dense(
         ctypes.c_void_p(mem.ctypes.data + offset),
@@ -232,6 +278,68 @@ def parse_libsvm_dense(
         ctypes.byref(res),
     )
     return res.rows_written, res.bytes_consumed, res.truncated, res.has_cr
+
+
+def parse_rowrec_ell(
+    chunk,
+    offset: int,
+    indices: np.ndarray,
+    values: np.ndarray,
+    nnz: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+) -> Optional[Tuple[int, int, int, int]]:
+    """Fused RecordIO frame scan + rowrec decode → ELL batch rows.
+
+    Parses complete RecordIO records from ``chunk[offset:]`` into rows
+    ``row_start..`` of the caller-owned ELL buffers:
+
+    - ``indices``: C-contiguous [capacity, K] int32
+    - ``values``: C-contiguous [capacity, K] float32 or float16
+    - ``nnz``: int32 [capacity]; ``labels``/``weights``: float32 [capacity]
+
+    Stops at buffer-full or at a trailing partial record (the caller's next
+    window must resume at ``offset + bytes_consumed``). Rows with more than
+    K features keep the first K (dropped count in ``truncated``). Returns
+    (rows_written, bytes_consumed, truncated, bad_records), or None if the
+    kernel is missing.
+    """
+    if not HAS_ELL:
+        return None
+    from ..utils.logging import check
+
+    mem = np.frombuffer(chunk, dtype=np.uint8)
+    check(indices.flags.c_contiguous and indices.dtype == np.int32,
+          "indices must be C-contiguous int32")
+    check(values.flags.c_contiguous
+          and values.dtype in (np.float32, np.float16),
+          "values must be C-contiguous float32/float16")
+    check(nnz.flags.c_contiguous and nnz.dtype == np.int32,
+          "nnz must be C-contiguous int32")
+    check(labels.flags.c_contiguous and labels.dtype == np.float32
+          and weights.flags.c_contiguous and weights.dtype == np.float32,
+          "labels/weights must be C-contiguous float32")
+    capacity, K = indices.shape
+    check(values.shape == (capacity, K), "values shape != indices shape")
+    check(len(nnz) >= capacity and len(labels) >= capacity
+          and len(weights) >= capacity, "1-D buffers shorter than capacity")
+    res = _EllResult()
+    _LIB.dmlc_parse_rowrec_ell(
+        ctypes.c_void_p(mem.ctypes.data + offset),
+        ctypes.c_int64(mem.size - offset),
+        ctypes.c_int64(K),
+        ctypes.c_int32(1 if values.dtype == np.float16 else 0),
+        ctypes.c_void_p(indices.ctypes.data),
+        ctypes.c_void_p(values.ctypes.data),
+        ctypes.c_void_p(nnz.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.byref(res),
+    )
+    return res.rows_written, res.bytes_consumed, res.truncated, res.bad_records
 
 
 load()

@@ -1,0 +1,206 @@
+"""rowrec: binary sparse-row payloads inside RecordIO containers.
+
+The reference treats RecordIO payloads as opaque bytes (include/dmlc/
+recordio.h:16-45) and parses *text* formats into RowBlocks; its Criteo-scale
+path is therefore text parse bound. The TPU-first redesign stores rows
+pre-parsed, so the .rec → HBM hot loop is a frame scan + memcpy instead of
+a float parse — this is what lets RecordIO staging saturate infeed
+(BASELINE.md north star #2).
+
+Per-record payload wire format (little-endian, mirrors the field set of
+reference data.h Row / row_block.h:189-215 Save):
+
+    label   f32
+    weight  f32
+    nnz     u32
+    indices u32[nnz]
+    values  f32[nnz]
+
+The RecordIO framing on top (magic/cflag multipart escape) is the
+reference-compatible codec in io/recordio.py; float payload bytes CAN
+collide with the magic word, so multipart chains genuinely occur and are
+exercised by tests/test_rowrec.py.
+
+Components:
+- encode_rows / decode_record: the codec (numpy-vectorized encode).
+- write_rowrec: RowBlock stream → .rec file via RecordIOWriter.
+- RowRecParser: Parser producing RowBlocks from a sharded .rec URI
+  (InputSplit type='recordio' → RecordIOChunkReader), registered as
+  format 'rowrec' in data/__init__.py. The fused native path
+  (staging/fused.py ell_batches) bypasses this and fills ELL buffers
+  directly (native/fastparse.cc dmlc_parse_rowrec_ell).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..io import split as io_split
+from ..io.recordio import RecordIOChunkReader, RecordIOWriter
+from ..io.stream import Stream
+from ..utils.logging import Error, check
+from .parser import Parser
+from .row_block import RowBlock
+
+__all__ = [
+    "encode_row",
+    "encode_rows",
+    "decode_record",
+    "decode_records",
+    "write_rowrec",
+    "RowRecParser",
+]
+
+_HEAD = struct.Struct("<ffI")  # label, weight, nnz
+
+
+def encode_row(
+    label: float,
+    indices: np.ndarray,
+    values: Optional[np.ndarray] = None,
+    weight: float = 1.0,
+) -> bytes:
+    """One sparse row → rowrec payload bytes."""
+    idx = np.ascontiguousarray(indices, dtype="<u4")
+    val = (
+        np.ones(len(idx), dtype="<f4")
+        if values is None
+        else np.ascontiguousarray(values, dtype="<f4")
+    )
+    check(len(idx) == len(val), "indices/values length mismatch")
+    return _HEAD.pack(label, weight, len(idx)) + idx.tobytes() + val.tobytes()
+
+
+def encode_rows(block: RowBlock) -> List[bytes]:
+    """RowBlock → list of per-row payloads (vectorized slicing)."""
+    nnz = np.diff(block.offset)
+    idx = block.index.astype("<u4", copy=False)
+    val = (
+        np.ones(block.nnz, dtype="<f4")
+        if block.value is None
+        else block.value.astype("<f4", copy=False)
+    )
+    weights = (
+        np.ones(block.size, dtype=np.float32)
+        if block.weight is None
+        else block.weight
+    )
+    out: List[bytes] = []
+    for i in range(block.size):
+        b, e = int(block.offset[i]), int(block.offset[i + 1])
+        out.append(
+            _HEAD.pack(float(block.label[i]), float(weights[i]), int(nnz[i]))
+            + idx[b:e].tobytes()
+            + val[b:e].tobytes()
+        )
+    return out
+
+
+def decode_record(payload) -> tuple:
+    """One payload → (label, weight, indices u32, values f32)."""
+    mv = memoryview(payload)
+    check(len(mv) >= 12, "rowrec payload shorter than its header")
+    label, weight, n = _HEAD.unpack_from(mv, 0)
+    check(len(mv) >= 12 + 8 * n, "rowrec payload shorter than declared nnz")
+    idx = np.frombuffer(mv, dtype="<u4", count=n, offset=12)
+    val = np.frombuffer(mv, dtype="<f4", count=n, offset=12 + 4 * n)
+    return label, weight, idx, val
+
+
+def decode_records(records: Iterable) -> RowBlock:
+    """Record payloads → one RowBlock (the generic/fallback decode path)."""
+    labels: List[float] = []
+    weights: List[float] = []
+    offsets: List[int] = [0]
+    idx_parts: List[np.ndarray] = []
+    val_parts: List[np.ndarray] = []
+    total = 0
+    for rec in records:
+        label, weight, idx, val = decode_record(rec)
+        labels.append(label)
+        weights.append(weight)
+        total += len(idx)
+        offsets.append(total)
+        idx_parts.append(idx)
+        val_parts.append(val)
+    index = (
+        np.concatenate(idx_parts).astype(np.uint32, copy=False)
+        if idx_parts
+        else np.empty(0, dtype=np.uint32)
+    )
+    value = (
+        np.concatenate(val_parts).astype(np.float32, copy=False)
+        if val_parts
+        else np.empty(0, dtype=np.float32)
+    )
+    return RowBlock(
+        offset=np.asarray(offsets, dtype=np.int64),
+        label=np.asarray(labels, dtype=np.float32),
+        index=index,
+        value=value,
+        weight=np.asarray(weights, dtype=np.float32),
+    )
+
+
+def write_rowrec(stream: Stream, blocks: Iterable[RowBlock]) -> int:
+    """Write RowBlocks as rowrec RecordIO frames; returns rows written."""
+    writer = RecordIOWriter(stream)
+    n = 0
+    for blk in blocks:
+        for payload in encode_rows(blk):
+            writer.write_record(payload)
+            n += 1
+    return n
+
+
+class RowRecParser(Parser):
+    """Sharded .rec → RowBlock parser (format='rowrec').
+
+    Pulls whole-record chunks from an InputSplit (type='recordio', so
+    byte-range sharding snaps to record heads — reference
+    src/io/recordio_split.cc), then decodes each chunk's records into one
+    RowBlock. Decode is cheap (memcpy-shaped) relative to text parse, so no
+    per-chunk thread fan-out is needed; ThreadedParser provides parse-ahead.
+    """
+
+    def __init__(
+        self,
+        source: Optional[io_split.InputSplit] = None,
+        args: Optional[dict] = None,
+        nthread: Optional[int] = None,
+        index_dtype=np.uint32,
+        uri: Optional[str] = None,
+        part_index: int = 0,
+        num_parts: int = 1,
+    ) -> None:
+        if source is None:
+            check(uri is not None, "RowRecParser needs a source or a uri")
+            source = io_split.create(
+                uri, part_index, num_parts, type="recordio"
+            )
+        self._source = source
+        self._bytes = 0
+        self._index_dtype = index_dtype
+
+    def parse_next(self) -> Optional[List[RowBlock]]:
+        chunk = self._source.next_chunk()
+        if chunk is None:
+            return None
+        self._bytes += len(chunk)
+        blk = decode_records(RecordIOChunkReader(chunk, 0, 1))
+        if blk.index.dtype != self._index_dtype:
+            blk.index = blk.index.astype(self._index_dtype)
+        return [blk]
+
+    def before_first(self) -> None:
+        self._source.before_first()
+        self._bytes = 0
+
+    def bytes_read(self) -> int:
+        return self._bytes
+
+    def close(self) -> None:
+        self._source.close()

@@ -6,7 +6,12 @@ bounded in-flight depth, optionally sharded over a jax Mesh data axis.
 """
 
 from .batcher import Batch, BatchSpec, FixedShapeBatcher
-from .fused import FusedDenseLibSVMBatches, dense_batches
+from .fused import (
+    FusedDenseLibSVMBatches,
+    FusedEllRowRecBatches,
+    dense_batches,
+    ell_batches,
+)
 from .pipeline import StagingPipeline, stage_batch
 
 __all__ = [
@@ -14,7 +19,9 @@ __all__ = [
     "BatchSpec",
     "FixedShapeBatcher",
     "FusedDenseLibSVMBatches",
+    "FusedEllRowRecBatches",
     "StagingPipeline",
     "dense_batches",
+    "ell_batches",
     "stage_batch",
 ]

@@ -1,22 +1,29 @@
-"""Fused native staging: libsvm text chunks → fixed-shape dense batches.
+"""Fused native staging: text/RecordIO chunks → fixed-shape batches.
 
-The single-pass hot path for the north-star metric (BASELINE.md ≥1M rows/s
-into HBM). Where the generic path materializes CSR RowBlocks and re-shapes
-them in Python (parser → RowBlock → FixedShapeBatcher), this hands each
-~8MB chunk straight to the native kernel (native/fastparse.cc
-dmlc_parse_libsvm_dense), which parses text directly into a ring of
-preallocated dense batch buffers — no CSR arrays, no copies, no per-row
-Python. The ring is the reference's recycle-cell discipline
-(threadediter.h:155-172) applied to whole batches.
+The single-pass hot paths for both north-star metrics (BASELINE.md: ≥1M
+libsvm rows/s into HBM; RecordIO infeed saturation). Where the generic
+path materializes CSR RowBlocks and re-shapes them in Python (parser →
+RowBlock → FixedShapeBatcher), these hand each chunk straight to a native
+kernel (native/fastparse.cc), which fills a ring of preallocated batch
+buffers — no CSR arrays, no copies, no per-row Python. The ring is the
+reference's recycle-cell discipline (threadediter.h:155-172) applied to
+whole batches.
 
-Semantics match LibSVMParser + FixedShapeBatcher('dense') composed, with
-two documented divergences:
-- libsvm auto indexing (indexing_mode=-1; the default is 0 = keep ids
-  as-is, matching LibSVMParserParam / reference libsvm_parser.h:31) is
-  resolved ONCE by sampling the head of the first chunk (the generic path
-  re-applies the min-index heuristic per chunk slice);
-- qid tokens are consumed but not carried (dense batches have no qid
-  field, same as the generic dense batcher).
+- FusedDenseLibSVMBatches: libsvm text → dense [B,D]
+  (dmlc_parse_libsvm_dense). Semantics match LibSVMParser +
+  FixedShapeBatcher('dense') composed, with two documented divergences:
+  libsvm auto indexing (indexing_mode=-1) is resolved ONCE from the head
+  of the FILE (the generic path re-applies the min-index heuristic per
+  chunk slice), and qid tokens are consumed but not carried.
+- FusedEllRowRecBatches: rowrec RecordIO → ELL [B,K]
+  (dmlc_parse_rowrec_ell). Semantics match RowRecParser +
+  FixedShapeBatcher('ell') composed; rows wider than K keep their first K
+  features (counted in ``truncated_nnz``).
+
+Producers expose ``ring_slots`` so consumers composing them with a
+prefetch/in-flight pipeline (StagingPipeline) can validate the ring is
+deep enough — a yielded batch is only valid until ``ring_slots - 1``
+further batches have been produced.
 """
 
 from __future__ import annotations
@@ -29,11 +36,17 @@ import numpy as np
 
 from ..data import native
 from ..io import split as io_split
+from ..io.filesystem import FileSystem
 from ..io.uri import URISpec
 from ..utils.logging import Error, check
 from .batcher import Batch, BatchSpec
 
-__all__ = ["FusedDenseLibSVMBatches", "dense_batches"]
+__all__ = [
+    "FusedDenseLibSVMBatches",
+    "FusedEllRowRecBatches",
+    "dense_batches",
+    "ell_batches",
+]
 
 _BOM = b"\xef\xbb\xbf"
 _MMAP_CHUNK = 32 << 20
@@ -93,6 +106,24 @@ class _MmapChunks:
         self._f.close()
 
 
+def _probe_base_from_uri(uri: str) -> int:
+    """Resolve libsvm auto indexing from the head of the FIRST file.
+
+    Probing at offset 0 (not at this shard's own first chunk) keeps the
+    resolved base identical across all (part_index, num_parts) shards —
+    different shards must never disagree and silently shift feature
+    columns against each other.
+    """
+    fs = FileSystem.get_instance(uri.split(";")[0])
+    first = io_split._expand_uris(fs, uri)[0]
+    stream = fs.open(first, "r")
+    try:
+        head = stream.read(262144)
+    finally:
+        stream.close()
+    return _probe_base(head)
+
+
 def _probe_base(chunk) -> int:
     """Resolve the libsvm auto indexing mode from the head of a chunk.
 
@@ -147,6 +178,10 @@ class FusedDenseLibSVMBatches:
             # per-dataset options ride the URI (reference uri_spec.h), same
             # as the generic LibSVMParser path
             indexing_mode = int(uspec.args["indexing_mode"])
+        if indexing_mode < 0 and num_parts > 1:
+            # auto mode must resolve identically on every shard: probe the
+            # head of the file, not this shard's mid-file first chunk
+            indexing_mode = _probe_base_from_uri(uspec.uri)
         self._indexing_mode = indexing_mode
         local = _plain_local_path(uspec.uri) if num_parts == 1 else None
         self._split = (
@@ -163,6 +198,7 @@ class FusedDenseLibSVMBatches:
             )
             for _ in range(max(2, ring))
         ]
+        self.ring_slots = len(self._ring)
         self._slot = 0
         self.rows_in = 0
         self.rows_out = 0
@@ -226,10 +262,252 @@ class FusedDenseLibSVMBatches:
         self._split.close()
 
 
-class _GenericDenseStream:
-    """Fallback dense Batch stream: generic parser → FixedShapeBatcher.
+class FusedEllRowRecBatches:
+    """Iterator of ELL Batches over a rowrec RecordIO URI via the fused
+    native kernel (native/fastparse.cc dmlc_parse_rowrec_ell).
 
-    Same iterate/close surface as FusedDenseLibSVMBatches, so callers can
+    The RecordIO→HBM hot path (BASELINE.md north star #2): RecordIO frame
+    scan + binary rowrec decode + ELL fill in one native pass, writing into
+    a ring of preallocated buffer sets. For a single local file the kernel
+    consumes raw mmap windows directly (it stops cleanly at a trailing
+    partial record, so no boundary pre-scan is needed); sharded/remote URIs
+    go through RecordIOSplitter chunks (record-aligned byte-range sharding,
+    reference src/io/recordio_split.cc).
+
+    A yielded batch stays valid until ``ring_slots - 1`` further batches
+    have been produced.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_ELL, "native fused ELL kernel not loaded")
+        check(spec.layout == "ell", "fused rowrec path requires layout='ell'")
+        check(spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16)),
+              f"fused path supports f32/f16 values, not {spec.value_dtype}")
+        check(spec.index_dtype == np.dtype(np.int32),
+              "fused ELL path stages int32 indices")
+        self.spec = spec
+        uspec = URISpec(uri, part_index, num_parts)
+        local = _plain_local_path(uspec.uri) if num_parts == 1 else None
+        self._mmap = local is not None
+        self._split = (
+            _MmapRawChunks(local)
+            if local is not None
+            else io_split.create(uspec.uri, part_index, num_parts,
+                                 type="recordio")
+        )
+        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
+        self._ring: List[Tuple[np.ndarray, ...]] = [
+            (
+                np.zeros((B, K), dtype=np.int32),
+                np.zeros((B, K), dtype=spec.value_dtype),
+                np.zeros(B, dtype=np.int32),
+                np.zeros(B, dtype=np.float32),
+                np.zeros(B, dtype=np.float32),
+            )
+            for _ in range(max(2, ring))
+        ]
+        self.ring_slots = len(self._ring)
+        self._slot = 0
+        self.rows_in = 0
+        self.rows_out = 0
+        self.truncated_nnz = 0
+        self.bad_records = 0
+
+    def _emit(self, bufs, n_valid: int) -> Batch:
+        indices, values, nnz, labels, weights = bufs
+        self.rows_out += n_valid
+        if self.spec.overflow == "error" and self.truncated_nnz:
+            raise Error(
+                f"{self.truncated_nnz} features beyond max_nnz="
+                f"{self.spec.max_nnz} with overflow='error'"
+            )
+        return Batch(
+            labels=labels, weights=weights, n_valid=n_valid,
+            indices=indices, values=values, nnz=nnz,
+        )
+
+    def _feed(self, chunk, off: int, fill: int):
+        """Parse chunk[off:] into the current slot; returns updated
+        (off, fill, made_progress)."""
+        bufs = self._ring[self._slot]
+        indices, values, nnz, labels, weights = bufs
+        rows, consumed, trunc, bad = native.parse_rowrec_ell(
+            chunk, off, indices, values, nnz, labels, weights, fill
+        )
+        self.rows_in += rows
+        self.truncated_nnz += trunc
+        self.bad_records += bad
+        return off + consumed, fill + rows, (rows > 0 or consumed > 0)
+
+    def __iter__(self) -> Iterator[Batch]:
+        B = self.spec.batch_size
+        fill = 0
+        if self._mmap:
+            yield from self._iter_mmap()
+            return
+        carry = b""
+        while True:
+            chunk = self._split.next_chunk()
+            if chunk is None:
+                break
+            if carry:
+                chunk = carry + bytes(chunk)
+                carry = b""
+            off, n = 0, len(chunk)
+            while off < n:
+                off, fill, progressed = self._feed(chunk, off, fill)
+                if fill == B:
+                    yield self._emit(self._ring[self._slot], B)
+                    self._slot = (self._slot + 1) % len(self._ring)
+                    fill = 0
+                elif not progressed:
+                    # trailing partial record (a chain straddling the
+                    # chunk boundary) — or a corrupt frame, which never
+                    # completes and is diagnosed at end of split: carry
+                    # the tail into the next chunk
+                    carry = bytes(memoryview(chunk)[off:])
+                    break
+        if carry:
+            raise Error(
+                "rowrec: truncated or corrupt RecordIO stream "
+                f"({len(carry)} undecodable trailing bytes)"
+            )
+        if fill:
+            yield from self._tail(fill)
+
+    def _iter_mmap(self) -> Iterator[Batch]:
+        B = self.spec.batch_size
+        fill = 0
+        while True:
+            chunk = self._split.window()
+            if chunk is None:
+                break
+            off, n = 0, len(chunk)
+            stalled = False
+            while off < n:
+                off, fill, progressed = self._feed(chunk, off, fill)
+                if fill == B:
+                    yield self._emit(self._ring[self._slot], B)
+                    self._slot = (self._slot + 1) % len(self._ring)
+                    fill = 0
+                elif not progressed:
+                    stalled = True
+                    break
+            self._split.advance(off)
+            if stalled and off == 0:
+                # not one complete record fit the window: widen it (a
+                # window that already reaches EOF means a truncated file)
+                if not self._split.grow():
+                    raise Error(
+                        "rowrec: record larger than remaining file or "
+                        "corrupt RecordIO frame"
+                    )
+        if fill:
+            yield from self._tail(fill)
+
+    def _tail(self, fill: int) -> Iterator[Batch]:
+        # zero-pad the final partial batch; padding rows carry weight 0
+        indices, values, nnz, labels, weights = self._ring[self._slot]
+        indices[fill:] = 0
+        values[fill:] = 0
+        nnz[fill:] = 0
+        labels[fill:] = 0
+        weights[fill:] = 0
+        yield self._emit(self._ring[self._slot], fill)
+        self._slot = (self._slot + 1) % len(self._ring)
+
+    def close(self) -> None:
+        self._split.close()
+
+
+class _MmapRawChunks:
+    """Raw byte windows over a local file via mmap, with caller-driven
+    consumption: the fused RecordIO kernel stops at a trailing partial
+    record and reports bytes consumed, so windows need no record-boundary
+    pre-scan — ``advance(consumed)`` moves the cursor, ``grow()`` widens
+    the window when a single record exceeds it."""
+
+    def __init__(self, path: str, chunk_bytes: int = _MMAP_CHUNK) -> None:
+        self._f = open(path, "rb")
+        self._size = os.fstat(self._f.fileno()).st_size
+        self._mm = (
+            mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+            if self._size
+            else None
+        )
+        self._chunk = chunk_bytes
+        self._pos = 0
+        self._width = chunk_bytes
+
+    def window(self):
+        """Current memoryview window, or None at EOF."""
+        if self._mm is None or self._pos >= self._size:
+            return None
+        end = min(self._pos + self._width, self._size)
+        return memoryview(self._mm)[self._pos:end]
+
+    def advance(self, consumed: int) -> None:
+        self._pos += consumed
+        if consumed:
+            self._width = self._chunk  # reset growth once progress resumes
+
+    def grow(self) -> bool:
+        """Widen the window (a record straddles it). False if the window
+        already reaches EOF — the file is truncated/corrupt."""
+        if self._pos + self._width >= self._size:
+            return False
+        self._width *= 2
+        return True
+
+    def close(self) -> None:
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # a yielded memoryview is still alive; GC will finish
+            self._mm = None
+        self._f.close()
+
+
+def ell_batches(
+    uri: str,
+    spec: BatchSpec,
+    part_index: int = 0,
+    num_parts: int = 1,
+    ring: int = 8,
+):
+    """Best-available ELL Batch stream for a rowrec RecordIO URI.
+
+    Uses the fused native kernel when loaded, otherwise the generic
+    RowRecParser → FixedShapeBatcher path with the same semantics. Either
+    way the result is iterable and has ``.close()``.
+    """
+    if (
+        native.HAS_ELL
+        and spec.layout == "ell"
+        and spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16))
+        and spec.index_dtype == np.dtype(np.int32)
+        and spec.overflow == "truncate"
+    ):
+        return FusedEllRowRecBatches(uri, spec, part_index, num_parts, ring)
+    from ..data import create_parser
+    from .batcher import FixedShapeBatcher
+
+    parser = create_parser(uri, part_index, num_parts, type="rowrec")
+    return _GenericBatchStream(parser, FixedShapeBatcher(spec))
+
+
+class _GenericBatchStream:
+    """Fallback Batch stream: generic parser → FixedShapeBatcher.
+
+    Same iterate/close surface as the fused producers, so callers can
     always close the underlying parser (parse-ahead thread + input file).
     """
 
@@ -283,4 +561,4 @@ def dense_batches(
     parser = create_parser(
         uri, part_index, num_parts, type="libsvm", nthread=nthread
     )
-    return _GenericDenseStream(parser, FixedShapeBatcher(spec))
+    return _GenericBatchStream(parser, FixedShapeBatcher(spec))

@@ -113,6 +113,21 @@ class StagingPipeline:
         self._mesh = mesh
         self._data_axis = data_axis
         self._depth = max(1, depth)
+        # ring-buffer producers (staging/fused.py) recycle host buffers; a
+        # ring shallower than everything this pipeline keeps in flight
+        # (prefetch queue + device transfers + the batch handed to the
+        # consumer) would silently corrupt staged batches — reject it here
+        ring_slots = getattr(host_batches, "ring_slots", None)
+        if ring_slots is not None:
+            need = prefetch + self._depth + 1
+            from ..utils.logging import check
+
+            check(
+                ring_slots >= need,
+                f"producer ring has {ring_slots} slots but the pipeline "
+                f"keeps up to {need} batches alive "
+                f"(prefetch={prefetch} + depth={self._depth} + 1 consumed)",
+            )
         self._host_iter: ThreadedIter[Batch] = ThreadedIter(
             lambda: iter(host_batches), max_capacity=prefetch, name="staging"
         )

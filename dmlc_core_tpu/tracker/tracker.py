@@ -120,6 +120,14 @@ class WorkerEntry:
             self.sock.send_int(-1)
         while True:
             ngood = self.sock.recv_int()
+            # client-controlled count: bound BEFORE reading, or a hostile
+            # client feeds an unbounded int stream into the single-threaded
+            # accept loop
+            if not 0 <= ngood <= len(nnset):
+                raise ProtocolError(
+                    f"rank {rank} reported {ngood} good links; neighbor "
+                    f"set has only {len(nnset)}"
+                )
             goodset = {self.sock.recv_int() for _ in range(ngood)}
             if not goodset.issubset(nnset):
                 # client-controlled field: never assert (the reference
@@ -309,6 +317,11 @@ class RabitTracker:
                     # path — same contract as any post-assignment death.)
                     if rank in todo_nodes:
                         todo_nodes.remove(rank)
+                    # record the memo for direct-assigned workers too, so
+                    # the jobid→rank hijack checks protect them and their
+                    # own recover path finds the rank again
+                    if entry.jobid != "NULL":
+                        job_map[entry.jobid] = rank
                     logger.debug("%s signal from %d", entry.cmd, entry.rank)
                     if entry.wait_accept > 0:
                         wait_conn[entry.rank] = entry

@@ -500,6 +500,8 @@ DMLC_API void dmlc_free_result(ParseResult* r) {
   delete reinterpret_cast<Holder*>(r);
 }
 
+
+
 // -- fused libsvm -> fixed-shape dense batch ---------------------------------
 //
 // The TPU-specific hot path (SURVEY §7 step 4/5): parses libsvm text straight
@@ -786,4 +788,192 @@ DMLC_API void dmlc_parse_libsvm_dense(
   out->bytes_consumed = p - buf;
   out->truncated = st.truncated;
   out->has_cr = has_cr ? 1 : 0;
+}
+
+// -- RecordIO frame scan + fused rowrec -> ELL batch --------------------------
+//
+// RecordIO frame (bit-compatible with reference include/dmlc/recordio.h:16-45):
+//   [kMagic u32][lrec u32][payload][pad to 4B]   lrec = cflag<<29 | len
+// cflag: 0 complete, 1 start, 2 middle, 3 end of a multi-part chain (the
+// writer splits a record at aligned in-payload magic words; the elided magic
+// is re-inserted between parts on read, reference src/recordio.cc:53-82).
+//
+// Payload ("rowrec" sparse-row wire format, dmlc_core_tpu/data/rowrec.py):
+//   label f32 | weight f32 | nnz u32 | indices u32[nnz] | values f32[nnz]
+//
+// The kernel consumes complete records from an arbitrary byte window and
+// stops at buffer-full or at a trailing partial record/chain (reporting
+// bytes consumed up to the chain start), so callers can hand it raw
+// byte-ranges without any boundary pre-scan.
+
+namespace {
+
+constexpr uint32_t kRecMagic = 0xced7230au;  // reference recordio.h:43
+
+inline uint32_t load_u32(const char* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // host is little-endian (x86/ARM TPU hosts); format is LE
+}
+
+inline float load_f32(const char* p) {
+  float v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+struct EllState {
+  int32_t* indices;  // [capacity, K]
+  void* values;      // [capacity, K] f32 or f16
+  int32_t* nnz;      // [capacity]
+  float* labels;     // [capacity]
+  float* weights;    // [capacity]
+  int64_t K;
+  bool f16;
+  int64_t truncated;
+};
+
+// Decode one rowrec payload into ELL row `row`. Returns false on a
+// malformed payload (declared sizes exceed the payload).
+inline bool rowrec_to_ell(const char* p, int64_t len, EllState& st,
+                          int64_t row) {
+  if (len < 12) return false;
+  const uint32_t n = load_u32(p + 8);
+  if (len < 12 + static_cast<int64_t>(n) * 8) return false;
+  st.labels[row] = load_f32(p);
+  st.weights[row] = load_f32(p + 4);
+  const char* idx = p + 12;
+  const char* val = idx + static_cast<int64_t>(n) * 4;
+  // semantics match FixedShapeBatcher._to_ell (staging/batcher.py): the
+  // first K positions are kept; within them, ids that don't fit the
+  // device index dtype (int32) are zeroed in place and counted truncated
+  // (never cast-aliased to negative); beyond-K features are dropped.
+  const int64_t keep = std::min<int64_t>(n, st.K);
+  st.truncated += static_cast<int64_t>(n) - keep;
+  int32_t* irow = st.indices + row * st.K;
+  int64_t kept = 0;
+  for (int64_t i = 0; i < keep; ++i) {
+    const uint32_t u = load_u32(idx + i * 4);
+    if (u > 0x7fffffffu) {
+      irow[i] = 0;
+      ++st.truncated;
+    } else {
+      irow[i] = static_cast<int32_t>(u);
+      ++kept;
+    }
+  }
+  std::memset(irow + keep, 0, static_cast<size_t>(st.K - keep) * 4);
+  if (st.f16) {
+    uint16_t* vrow = static_cast<uint16_t*>(st.values) + row * st.K;
+    for (int64_t i = 0; i < keep; ++i) {
+      const uint32_t u = load_u32(idx + i * 4);
+      vrow[i] = u > 0x7fffffffu ? 0 : f32_to_f16(load_f32(val + i * 4));
+    }
+    std::memset(vrow + keep, 0, static_cast<size_t>(st.K - keep) * 2);
+  } else {
+    float* vrow = static_cast<float*>(st.values) + row * st.K;
+    std::memcpy(vrow, val, static_cast<size_t>(keep) * 4);
+    for (int64_t i = 0; i < keep; ++i) {
+      if (load_u32(idx + i * 4) > 0x7fffffffu) vrow[i] = 0.0f;
+    }
+    std::memset(vrow + keep, 0, static_cast<size_t>(st.K - keep) * 4);
+  }
+  st.nnz[row] = static_cast<int32_t>(kept);
+  return true;
+}
+
+}  // namespace
+
+struct EllResult {
+  int64_t rows_written;
+  int64_t bytes_consumed;
+  int64_t truncated;
+  int64_t bad_records;  // malformed payloads skipped
+};
+
+DMLC_API void dmlc_parse_rowrec_ell(
+    const char* buf, int64_t len, int64_t max_nnz, int32_t out_f16,
+    int32_t* indices, void* values, int32_t* nnz, float* labels,
+    float* weights, int64_t row_start, int64_t row_capacity,
+    EllResult* out) {
+  EllState st{indices, values, nnz, labels, weights, max_nnz, out_f16 != 0, 0};
+  int64_t row = row_start;
+  int64_t bad = 0;
+  const char* p = buf;
+  const char* end = buf + len;
+  std::vector<char> chain;  // reassembly buffer for multi-part records
+  const char* consumed_to = buf;
+  while (row < row_capacity) {
+    // walk one record (possibly a multi-part chain) starting at p
+    const char* rec_start = p;
+    chain.clear();
+    bool in_chain = false;
+    bool complete = false;
+    const char* payload = nullptr;
+    int64_t payload_len = 0;
+    while (true) {
+      if (end - p < 8) break;  // partial header: stop at rec_start
+      const uint32_t magic = load_u32(p);
+      if (magic != kRecMagic) {
+        // corrupt frame — unrecoverable inside this window; report what we
+        // have (the Python side raises on bytes_consumed going nowhere)
+        break;
+      }
+      const uint32_t lrec = load_u32(p + 4);
+      const uint32_t cflag = (lrec >> 29) & 7u;
+      const int64_t plen = static_cast<int64_t>(lrec & ((1u << 29) - 1u));
+      const int64_t upper = (plen + 3) & ~int64_t{3};
+      if (end - p < 8 + upper) break;  // partial payload
+      const char* data = p + 8;
+      p += 8 + upper;
+      if (cflag == 0) {
+        // complete standalone record; if a chain was pending this abandons
+        // it, matching RecordIOChunkReader.next_record (io/recordio.py)
+        payload = data;
+        payload_len = plen;
+        complete = true;
+        break;
+      }
+      // multi-part chain: parts are joined with the elided magic word
+      // re-inserted between them (reference recordio.cc:63-77)
+      if (in_chain) {
+        const char m[4] = {'\x0a', '\x23', '\xd7', '\xce'};  // LE kRecMagic
+        chain.insert(chain.end(), m, m + 4);
+      }
+      chain.insert(chain.end(), data, data + plen);
+      in_chain = true;
+      if (cflag == 3) {
+        payload = chain.data();
+        payload_len = static_cast<int64_t>(chain.size());
+        complete = true;
+        break;
+      }
+      // cflag 1 or 2: chain continues with the next frame
+    }
+    if (!complete) {
+      p = rec_start;  // leave the partial chain for the caller's next window
+      break;
+    }
+    if (rowrec_to_ell(payload, payload_len, st, row)) {
+      ++row;
+    } else {
+      ++bad;
+    }
+    consumed_to = p;
+  }
+  out->rows_written = row - row_start;
+  out->bytes_consumed = consumed_to - buf;
+  out->truncated = st.truncated;
+  out->bad_records = bad;
+}
+
+// Build stamp: the Makefile passes -DDMLC_SRC_HASH="sha256 of fastparse.cc"
+// so callers (bench.py ensure_native) can detect a stale prebuilt .so after
+// a failed rebuild instead of silently benchmarking last round's binary.
+DMLC_API const char* dmlc_source_hash() {
+#ifdef DMLC_SRC_HASH
+  return DMLC_SRC_HASH;
+#else
+  return "";
+#endif
 }

@@ -1,0 +1,325 @@
+"""rowrec codec + RecordIO→ELL staging: parity, sharding, multipart.
+
+Covers the RecordIO→HBM path (BASELINE.md north star #2): the rowrec
+payload codec (data/rowrec.py), the generic RowRecParser, and the fused
+native kernel (native/fastparse.cc dmlc_parse_rowrec_ell +
+staging/fused.py FusedEllRowRecBatches), which must produce identical
+batches to RowRecParser → FixedShapeBatcher('ell') composed.
+
+Multipart records (payloads containing the aligned RecordIO magic word)
+and records straddling chunk windows mirror the reference's stress cases
+(reference test/unittest/unittest_inputsplit.cc:147-190).
+"""
+
+import os
+import struct
+import tempfile
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import create_parser, native
+from dmlc_core_tpu.data.rowrec import (
+    decode_record,
+    decode_records,
+    encode_row,
+    encode_rows,
+    write_rowrec,
+)
+from dmlc_core_tpu.data.row_block import RowBlock
+from dmlc_core_tpu.io.recordio import (
+    KMAGIC,
+    RecordIOChunkReader,
+    RecordIOReader,
+    RecordIOWriter,
+)
+from dmlc_core_tpu.io.stream import FileStream, MemoryStream
+from dmlc_core_tpu.staging import BatchSpec, FixedShapeBatcher
+from dmlc_core_tpu.utils.logging import Error
+
+MAGIC_F32 = struct.unpack("<f", struct.pack("<I", KMAGIC))[0]  # collides
+
+
+def _random_block(rng, n_rows, max_nnz=12, max_index=1000, magic_every=0):
+    """Random ragged RowBlock; every `magic_every`-th value is the float
+    whose bits equal the RecordIO magic word, forcing multipart frames."""
+    nnz = rng.integers(1, max_nnz + 1, n_rows)
+    offset = np.zeros(n_rows + 1, dtype=np.int64)
+    offset[1:] = np.cumsum(nnz)
+    total = int(offset[-1])
+    index = rng.integers(0, max_index, total).astype(np.uint32)
+    value = rng.normal(size=total).astype(np.float32)
+    if magic_every:
+        value[::magic_every] = MAGIC_F32
+    return RowBlock(
+        offset=offset,
+        label=rng.integers(0, 2, n_rows).astype(np.float32),
+        index=index,
+        value=value,
+        weight=rng.uniform(0.5, 2.0, n_rows).astype(np.float32),
+    )
+
+
+def _write_rec(path, block):
+    stream = FileStream(path, "w")
+    n = write_rowrec(stream, [block])
+    stream.close()
+    return n
+
+
+def test_codec_roundtrip_single():
+    payload = encode_row(1.0, np.array([3, 7, 9]), np.array([0.5, -1.5, 2.0]))
+    label, weight, idx, val = decode_record(payload)
+    assert label == 1.0 and weight == 1.0
+    np.testing.assert_array_equal(idx, [3, 7, 9])
+    np.testing.assert_array_equal(val, [0.5, -1.5, 2.0])
+
+
+def test_codec_roundtrip_block():
+    rng = np.random.default_rng(0)
+    blk = _random_block(rng, 50)
+    payloads = encode_rows(blk)
+    assert len(payloads) == 50
+    out = decode_records(payloads)
+    np.testing.assert_array_equal(out.offset, blk.offset)
+    np.testing.assert_array_equal(out.label, blk.label)
+    np.testing.assert_array_equal(out.index, blk.index)
+    np.testing.assert_array_equal(out.value, blk.value)
+    np.testing.assert_array_equal(out.weight, blk.weight)
+
+
+def test_codec_rejects_truncated_payload():
+    payload = encode_row(1.0, np.array([3, 7]), np.array([0.5, 1.5]))
+    with pytest.raises(Error):
+        decode_record(payload[:8])
+    with pytest.raises(Error):
+        decode_record(payload[:-4])  # declared nnz exceeds payload
+
+
+def test_magic_collision_roundtrips_via_recordio():
+    """Payloads containing the aligned magic word must survive the
+    writer's multipart escape (reference src/recordio.cc:11-51)."""
+    rng = np.random.default_rng(1)
+    blk = _random_block(rng, 40, magic_every=5)
+    ms = MemoryStream()
+    writer = RecordIOWriter(ms)
+    payloads = encode_rows(blk)
+    for p in payloads:
+        writer.write_record(p)
+    assert writer.except_counter > 0, "test data produced no collisions"
+    ms.seek(0)
+    back = list(RecordIOReader(ms))
+    assert [bytes(b) for b in back] == [bytes(p) for p in payloads]
+    out = decode_records(back)
+    np.testing.assert_array_equal(out.value, blk.value)
+
+
+def test_rowrec_parser_end_to_end(tmp_path):
+    rng = np.random.default_rng(2)
+    blk = _random_block(rng, 300)
+    path = str(tmp_path / "data.rec")
+    assert _write_rec(path, blk) == 300
+    parser = create_parser(path, type="rowrec", threaded=False)
+    blocks = list(iter(parser))
+    parser.close()
+    total = sum(b.size for b in blocks)
+    assert total == 300
+    merged = RowBlock.concat(blocks) if len(blocks) > 1 else blocks[0]
+    np.testing.assert_array_equal(merged.label, blk.label)
+    np.testing.assert_array_equal(merged.index, blk.index)
+    np.testing.assert_allclose(merged.value, blk.value)
+
+
+def test_rowrec_parser_sharded_exact_cover(tmp_path):
+    """Every row lands in exactly one shard (reference distributed-split
+    pattern, unittest_inputsplit.cc:116-145)."""
+    rng = np.random.default_rng(3)
+    blk = _random_block(rng, 500)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    labels = []
+    for part in range(4):
+        parser = create_parser(
+            path, part_index=part, num_parts=4, type="rowrec", threaded=False
+        )
+        for b in iter(parser):
+            labels.append(b.label)
+        parser.close()
+    got = np.concatenate(labels)
+    assert len(got) == 500
+    np.testing.assert_array_equal(np.sort(got), np.sort(blk.label))
+
+
+# -- fused native kernel ------------------------------------------------------
+
+fused = pytest.mark.skipif(
+    not native.HAS_ELL, reason="native fused ELL kernel not built"
+)
+
+
+def _generic_ell(path, spec, part_index=0, num_parts=1):
+    parser = create_parser(
+        path, part_index, num_parts, type="rowrec", threaded=False
+    )
+    out = list(FixedShapeBatcher(spec).batches(iter(parser)))
+    parser.close()
+    return out
+
+
+def _fused_ell(path, spec, part_index=0, num_parts=1, ring=8):
+    from dmlc_core_tpu.staging import FusedEllRowRecBatches
+
+    stream = FusedEllRowRecBatches(path, spec, part_index, num_parts, ring)
+    # copy: ring buffers are recycled
+    out = [
+        type(b)(
+            labels=b.labels.copy(), weights=b.weights.copy(),
+            n_valid=b.n_valid, indices=b.indices.copy(),
+            values=b.values.copy(), nnz=b.nnz.copy(),
+        )
+        for b in stream
+    ]
+    tr = stream.truncated_nnz
+    stream.close()
+    return out, tr
+
+
+def _assert_batches_equal(fused_batches, generic_batches):
+    assert len(fused_batches) == len(generic_batches)
+    for f, g in zip(fused_batches, generic_batches):
+        assert f.n_valid == g.n_valid
+        np.testing.assert_array_equal(f.labels, g.labels)
+        np.testing.assert_array_equal(f.weights, g.weights)
+        np.testing.assert_array_equal(f.nnz, g.nnz)
+        np.testing.assert_array_equal(f.indices, g.indices)
+        np.testing.assert_array_equal(f.values, g.values)
+
+
+@fused
+@pytest.mark.parametrize("value_dtype", ["float32", "float16"])
+def test_fused_matches_generic(tmp_path, value_dtype):
+    rng = np.random.default_rng(4)
+    blk = _random_block(rng, 700, max_nnz=8)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    spec = BatchSpec(
+        batch_size=128, layout="ell", max_nnz=8,
+        value_dtype=np.dtype(value_dtype),
+    )
+    fused_b, _ = _fused_ell(path, spec)
+    spec2 = BatchSpec(
+        batch_size=128, layout="ell", max_nnz=8,
+        value_dtype=np.dtype(value_dtype),
+    )
+    generic_b = _generic_ell(path, spec2)
+    _assert_batches_equal(fused_b, generic_b)
+
+
+@fused
+def test_fused_truncation_counts(tmp_path):
+    rng = np.random.default_rng(5)
+    blk = _random_block(rng, 100, max_nnz=10)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    spec = BatchSpec(batch_size=32, layout="ell", max_nnz=4)
+    fused_b, fused_tr = _fused_ell(path, spec)
+    gspec = BatchSpec(batch_size=32, layout="ell", max_nnz=4)
+    batcher = FixedShapeBatcher(gspec)
+    parser = create_parser(path, type="rowrec", threaded=False)
+    generic_b = list(batcher.batches(iter(parser)))
+    parser.close()
+    assert fused_tr == batcher.truncated_nnz > 0
+    _assert_batches_equal(fused_b, generic_b)
+
+
+@fused
+def test_fused_multipart_and_tiny_windows(tmp_path):
+    """Multipart chains + records straddling mmap windows: force a small
+    window so nearly every record crosses a boundary (reference chunk
+    straddle stress, unittest_inputsplit.cc:147-190)."""
+    from dmlc_core_tpu.staging import FusedEllRowRecBatches
+
+    rng = np.random.default_rng(6)
+    blk = _random_block(rng, 200, max_nnz=16, magic_every=7)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    spec = BatchSpec(batch_size=64, layout="ell", max_nnz=16)
+    stream = FusedEllRowRecBatches(path, spec)
+    assert stream._mmap
+    stream._split._chunk = 64  # tiny raw windows
+    stream._split._width = 64
+    got_labels = []
+    for b in stream:
+        got_labels.append(b.labels[: b.n_valid].copy())
+    stream.close()
+    np.testing.assert_array_equal(np.concatenate(got_labels), blk.label)
+    assert stream.bad_records == 0
+
+
+@fused
+def test_fused_sharded_exact_cover(tmp_path):
+    rng = np.random.default_rng(7)
+    blk = _random_block(rng, 600, max_nnz=6)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    spec = lambda: BatchSpec(batch_size=100, layout="ell", max_nnz=6)
+    rows = []
+    for part in range(3):
+        batches, _ = _fused_ell(path, spec(), part, 3)
+        for b in batches:
+            rows.append(b.labels[: b.n_valid])
+        # parity per shard too
+        _assert_batches_equal(batches, _generic_ell(path, spec(), part, 3))
+    got = np.concatenate(rows)
+    assert len(got) == 600
+    np.testing.assert_array_equal(np.sort(got), np.sort(blk.label))
+
+
+@fused
+def test_fused_corrupt_stream_raises(tmp_path):
+    rng = np.random.default_rng(8)
+    blk = _random_block(rng, 50)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) - 6)
+        f.write(b"\xde\xad")  # corrupt the final record's payload tail
+    data = open(path, "rb").read()
+    # corrupting payload bytes mid-file instead: flip a magic word
+    pos = data.index(struct.pack("<I", KMAGIC), 100)
+    corrupted = data[:pos] + b"\x00\x00\x00\x00" + data[pos + 4:]
+    bad = str(tmp_path / "bad.rec")
+    open(bad, "wb").write(corrupted)
+    spec = BatchSpec(batch_size=16, layout="ell", max_nnz=12)
+    with pytest.raises(Error):
+        _fused_ell(bad, spec)
+
+
+def test_ell_batches_dispatcher_fallback(tmp_path, monkeypatch):
+    """ell_batches must fall back to the generic path when the kernel is
+    unavailable and produce the same batches."""
+    from dmlc_core_tpu.staging import ell_batches
+
+    rng = np.random.default_rng(9)
+    blk = _random_block(rng, 150, max_nnz=5)
+    path = str(tmp_path / "data.rec")
+    _write_rec(path, blk)
+
+    def run():
+        spec = BatchSpec(batch_size=50, layout="ell", max_nnz=5)
+        stream = ell_batches(path, spec)
+        out = [
+            type(b)(
+                labels=b.labels.copy(), weights=b.weights.copy(),
+                n_valid=b.n_valid, indices=b.indices.copy(),
+                values=b.values.copy(), nnz=b.nnz.copy(),
+            )
+            for b in stream
+        ]
+        stream.close()
+        return out
+
+    with_kernel = run()
+    monkeypatch.setattr(native, "HAS_ELL", False)
+    without_kernel = run()
+    _assert_batches_equal(with_kernel, without_kernel)
