@@ -1,18 +1,22 @@
-"""Benchmark: HIGGS-like libsvm → parse → fixed-shape batches → TPU HBM.
+"""Benchmark: both BASELINE.md north stars, staged end-to-end into HBM.
 
-Measures the north-star metric (BASELINE.md): parsed rows/sec staged into
-device memory, end to end (read → fused native parse→dense-batch kernel →
-async device_put). Prints ONE JSON line:
+1. HIGGS-like libsvm → fused native parse→dense-batch kernel → async
+   device_put (``higgs_staged_rows_per_sec``, the headline metric).
+2. Criteo-like RecordIO (rowrec binary sparse rows, 13 dense + 26
+   categorical features) → fused native frame-scan→ELL kernel →
+   async device_put (``recordio_staged_rows_per_sec`` +
+   ``recordio_staged_mb_per_sec``).
+
+Prints ONE JSON line:
 
     {"metric": "higgs_staged_rows_per_sec", "value": N,
      "unit": "rows/sec", "vs_baseline": N / 1_000_000,
-     "f32_rows_per_sec": N, ...}
+     "f32_rows_per_sec": N, "recordio_staged_rows_per_sec": N, ...}
 
 vs_baseline is against the 1M rows/sec target (the reference publishes no
-numbers of its own — SURVEY §6). The headline number stages feature values
-as float16 (halves infeed DMA; labels/weights stay f32); the float32
-number is reported alongside so dtype choices stay visible round over
-round.
+numbers of its own — SURVEY §6). Headline numbers stage feature values as
+float16 (halves infeed DMA; labels/weights stay f32); float32 numbers are
+reported alongside so dtype choices stay visible round over round.
 
 Run on the TPU host as-is (default jax device). Synthetic data is cached
 under /tmp between runs. Use BENCH_ROWS / BENCH_EPOCHS to resize.
@@ -38,12 +42,21 @@ BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 DATA = os.environ.get(
     "BENCH_DATA", f"/tmp/dmlc_tpu_bench_higgs_{N_ROWS}.libsvm"
 )
+# Criteo-like: 13 dense ("integer") + 26 categorical features per row,
+# categorical ids hashed into a 1M space (BASELINE.md north star #2)
+REC_ROWS = int(os.environ.get("BENCH_REC_ROWS", str(N_ROWS)))
+REC_DENSE, REC_CAT, REC_SPACE = 13, 26, 1 << 20
+REC_K = REC_DENSE + REC_CAT
+REC_DATA = os.environ.get(
+    "BENCH_REC_DATA", f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.rec"
+)
 
 
 def ensure_native() -> None:
     """Build/refresh the native core. An unusable native library is a
     bench failure, not a silent 5x-slower fallback (VERDICT r1 weak #3);
-    a failed *build* is tolerated when a working prebuilt .so loads."""
+    a failed *build* is tolerated only when the prebuilt .so that loads
+    matches the current source (hash stamp), never a stale one."""
     build_err = None
     try:
         proc = subprocess.run(
@@ -61,10 +74,31 @@ def ensure_native() -> None:
         if build_err:
             sys.stderr.write(build_err + "\n")
         raise RuntimeError("native library unavailable (build log above)")
+    import hashlib
+
+    src = os.path.join(REPO, "native", "fastparse.cc")
+    want = hashlib.sha256(open(src, "rb").read()).hexdigest()
+    got = native.source_hash()
+    if got != want and build_err is None:
+        # an up-to-date-by-mtime .so without a (current) stamp: force a
+        # relink and re-open the fresh .so
+        proc = subprocess.run(
+            ["make", "-B", "-C", os.path.join(REPO, "native")],
+            capture_output=True, text=True,
+        )
+        if proc.returncode == 0 and native.load(force=True):
+            got = native.source_hash()
+    if got != want:
+        if build_err:
+            sys.stderr.write(build_err + "\n")
+        raise RuntimeError(
+            f"native .so is stale (built from {got[:12] or 'unstamped'}, "
+            f"source is {want[:12]}); refusing to benchmark it"
+        )
     if build_err:
         sys.stderr.write(
-            "warning: native rebuild failed; benchmarking the prebuilt "
-            "library\n"
+            "warning: native rebuild failed; the prebuilt library matches "
+            "the source hash, benchmarking it\n"
         )
 
 
@@ -89,10 +123,74 @@ def ensure_data() -> None:
     os.replace(tmp, DATA)
 
 
-def run_epoch(value_dtype: str) -> dict:
-    import jax
+def ensure_rec_data() -> None:
+    """Synthetic Criteo-like rowrec RecordIO, generated vectorized.
 
-    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, dense_batches
+    Every row has exactly 39 features; values are small floats and ids
+    < 2^20, so no payload word can collide with the RecordIO magic —
+    asserted below, which keeps every frame single-part (cflag 0) and the
+    whole shard expressible as one fixed-stride numpy record array.
+    (Multipart correctness is covered by tests/test_rowrec.py; writer
+    parity of this fast generator is asserted against RecordIOWriter.)
+    """
+    if os.path.exists(REC_DATA) and os.path.getsize(REC_DATA) > 0:
+        return
+    from dmlc_core_tpu.io.recordio import KMAGIC, encode_lrec
+
+    rng = np.random.default_rng(7)
+    payload_len = 12 + REC_K * 8
+    frame = np.dtype(
+        [
+            ("magic", "<u4"),
+            ("lrec", "<u4"),
+            ("label", "<f4"),
+            ("weight", "<f4"),
+            ("nnz", "<u4"),
+            ("idx", "<u4", (REC_K,)),
+            ("val", "<f4", (REC_K,)),
+        ]
+    )
+    assert frame.itemsize == 8 + payload_len
+    tmp = REC_DATA + ".tmp"
+    chunk = 100_000
+    with open(tmp, "wb") as f:
+        for start in range(0, REC_ROWS, chunk):
+            n = min(chunk, REC_ROWS - start)
+            arr = np.zeros(n, dtype=frame)
+            arr["magic"] = KMAGIC
+            arr["lrec"] = encode_lrec(0, payload_len)
+            arr["label"] = rng.integers(0, 2, n)
+            arr["weight"] = 1.0
+            arr["nnz"] = REC_K
+            arr["idx"][:, :REC_DENSE] = np.arange(REC_DENSE)
+            arr["idx"][:, REC_DENSE:] = rng.integers(
+                REC_DENSE, REC_SPACE, (n, REC_CAT)
+            )
+            arr["val"][:, :REC_DENSE] = rng.uniform(0, 1, (n, REC_DENSE))
+            arr["val"][:, REC_DENSE:] = 1.0
+            # no in-payload aligned word may equal the magic (keeps cflag 0)
+            words = arr.view("<u4").reshape(n, frame.itemsize // 4)
+            assert not (words[:, 2:] == KMAGIC).any()
+            f.write(arr.tobytes())
+    # generator parity: the first frames must be byte-identical to what
+    # RecordIOWriter would emit for the same payloads
+    from dmlc_core_tpu.io.recordio import RecordIOReader, RecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream, MemoryStream
+
+    with FileStream(tmp, "r") as f:
+        reader = RecordIOReader(f)
+        payloads = [reader.next_record() for _ in range(3)]
+    ms = MemoryStream()
+    w = RecordIOWriter(ms)
+    for p in payloads:
+        w.write_record(p)
+    head = open(tmp, "rb").read(len(ms.getvalue()))
+    assert head == ms.getvalue(), "fast .rec generator diverges from writer"
+    os.replace(tmp, REC_DATA)
+
+
+def _make_higgs_stream(value_dtype: str):
+    from dmlc_core_tpu.staging import BatchSpec, dense_batches
 
     spec = BatchSpec(
         batch_size=BATCH,
@@ -100,14 +198,35 @@ def run_epoch(value_dtype: str) -> dict:
         num_features=N_FEATURES + 1,
         value_dtype=np.dtype(value_dtype),
     )
-    stream = dense_batches(DATA, spec)
+    return dense_batches(DATA, spec), "x", DATA
+
+
+def _make_rec_stream(value_dtype: str):
+    from dmlc_core_tpu.staging import BatchSpec, ell_batches
+
+    spec = BatchSpec(
+        batch_size=BATCH,
+        layout="ell",
+        max_nnz=REC_K,
+        value_dtype=np.dtype(value_dtype),
+    )
+    return ell_batches(REC_DATA, spec), "values", REC_DATA
+
+
+def run_epoch(make_stream, value_dtype: str) -> dict:
+    """One full file → device epoch; returns rows/sec + MB/sec."""
+    import jax
+
+    from dmlc_core_tpu.staging import StagingPipeline
+
+    stream, block_key, data_path = make_stream(value_dtype)
     pipe = StagingPipeline(stream, depth=2)
     t0 = time.perf_counter()
     last = None
     for dev in pipe:
         last = dev
     if last is not None:
-        jax.block_until_ready(last["x"])
+        jax.block_until_ready(last[block_key])
     dt = time.perf_counter() - t0
     if hasattr(stream, "close"):
         stream.close()
@@ -116,24 +235,33 @@ def run_epoch(value_dtype: str) -> dict:
         "rows": pipe.rows_staged,
         "secs": dt,
         "rows_per_sec": pipe.rows_staged / dt,
-        "device": str(jax.devices()[0]),
+        "mb_per_sec": os.path.getsize(data_path) / dt / 1e6,
     }
 
 
-def best_of(n: int, value_dtype: str) -> float:
-    best = 0.0
+def best_of(n: int, make_stream, value_dtype: str) -> dict:
+    best = {"rows_per_sec": 0.0, "mb_per_sec": 0.0}
     for _ in range(n):
-        best = max(best, run_epoch(value_dtype)["rows_per_sec"])
+        r = run_epoch(make_stream, value_dtype)
+        if r["rows_per_sec"] > best["rows_per_sec"]:
+            best = r
     return best
 
 
 def main() -> None:
     ensure_native()
     ensure_data()
+    ensure_rec_data()
     from dmlc_core_tpu.data import native
 
-    value = round(best_of(EPOCHS, "float16"), 1)
-    f32 = round(best_of(max(1, EPOCHS - 1), "float32"), 1)
+    # headline (f16) metrics first: the host↔device link on shared/tunneled
+    # TPU frontends throttles after sustained transfer, so later epochs
+    # understate; the f32 numbers are diagnostics and run last
+    value = round(best_of(EPOCHS, _make_higgs_stream, "float16")["rows_per_sec"], 1)
+    rec_best = best_of(EPOCHS, _make_rec_stream, "float16")
+    n32 = max(1, EPOCHS - 1)
+    f32 = round(best_of(n32, _make_higgs_stream, "float32")["rows_per_sec"], 1)
+    rec_f32 = best_of(n32, _make_rec_stream, "float32")["rows_per_sec"]
     print(
         json.dumps(
             {
@@ -142,8 +270,16 @@ def main() -> None:
                 "unit": "rows/sec",
                 "vs_baseline": round(value / 1_000_000, 4),
                 "f32_rows_per_sec": f32,
+                "recordio_staged_rows_per_sec": round(
+                    rec_best["rows_per_sec"], 1
+                ),
+                "recordio_staged_mb_per_sec": round(
+                    rec_best["mb_per_sec"], 1
+                ),
+                "recordio_f32_rows_per_sec": round(rec_f32, 1),
                 "native": native.AVAILABLE,
                 "fused_dense_kernel": native.HAS_DENSE,
+                "fused_ell_kernel": native.HAS_ELL,
                 "host_cpus": os.cpu_count(),
             }
         )

@@ -144,6 +144,49 @@ def bench_recordio(tmp: str) -> None:
     RESULTS["recordio_threaded_split_mb_per_sec"] = round(size / dt_s / 1e6, 1)
 
 
+def bench_recordio_staged(tmp: str) -> None:
+    """North star #2: rowrec RecordIO → fused ELL batches → device
+    (mirrors bench.py run_epoch_rec at run_all scale)."""
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return
+    from dmlc_core_tpu.data.row_block import RowBlock
+    from dmlc_core_tpu.data.rowrec import write_rowrec
+    from dmlc_core_tpu.io.stream import FileStream
+    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, ell_batches
+
+    rng = np.random.default_rng(3)
+    n, k = max(N_ROWS // 2, 1000), 39
+    offset = np.arange(n + 1, dtype=np.int64) * k
+    blk = RowBlock(
+        offset=offset,
+        label=rng.integers(0, 2, n).astype(np.float32),
+        index=rng.integers(0, 1 << 20, n * k).astype(np.uint32),
+        value=rng.uniform(0, 1, n * k).astype(np.float32),
+    )
+    path = os.path.join(tmp, "criteo.rec")
+    with FileStream(path, "w") as f:
+        write_rowrec(f, [blk])
+    spec = BatchSpec(
+        batch_size=4096, layout="ell", max_nnz=k,
+        value_dtype=np.dtype(np.float16),
+    )
+    stream = ell_batches(path, spec)
+    pipe = StagingPipeline(stream, depth=2)
+    t0 = time.perf_counter()
+    for _ in pipe:
+        pass
+    dt = time.perf_counter() - t0
+    assert pipe.rows_staged == n
+    stream.close()
+    pipe.close()
+    RESULTS["recordio_staged_rows_per_sec"] = round(n / dt, 1)
+    RESULTS["recordio_staged_mb_per_sec"] = round(
+        os.path.getsize(path) / dt / 1e6, 1
+    )
+
+
 def bench_sharded_split(tmp: str) -> None:
     from dmlc_core_tpu.io import split as io_split
 
@@ -224,6 +267,7 @@ def main() -> None:
             bench_libsvm,
             bench_csv_libfm,
             bench_recordio,
+            bench_recordio_staged,
             bench_sharded_split,
             bench_submit,
         ):

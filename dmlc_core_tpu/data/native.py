@@ -91,12 +91,20 @@ class _EllResult(ctypes.Structure):
     ]
 
 
-def load(path: Optional[str] = None) -> bool:
-    """Load the native library (idempotent). Returns availability."""
+def load(path: Optional[str] = None, force: bool = False) -> bool:
+    """Load the native library (idempotent). Returns availability.
+
+    ``force`` re-opens the .so even if one is already loaded — used after
+    an in-session rebuild (the rebuilt file is a new inode, so dlopen
+    returns a fresh handle; the old one is left to the process lifetime).
+    """
     global AVAILABLE, HAS_DENSE, HAS_ELL, _LIB
     with _LOCK:
-        if _LIB is not None:
+        if _LIB is not None and not force:
             return AVAILABLE
+        if force:
+            _LIB = None
+            AVAILABLE = HAS_DENSE = HAS_ELL = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES

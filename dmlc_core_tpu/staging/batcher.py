@@ -44,6 +44,11 @@ class Batch:
     values: Optional[np.ndarray] = None
     nnz: Optional[np.ndarray] = None
     x: Optional[np.ndarray] = None
+    # single contiguous uint8 buffer the other arrays are views into
+    # (fused producers set this): lets the staging pipeline issue ONE
+    # device transfer per batch and bitcast-unpack in HBM, instead of
+    # five small DMAs (staging/pipeline.py packed path)
+    packed: Optional[np.ndarray] = None
 
     @property
     def batch_size(self) -> int:
@@ -114,6 +119,12 @@ class FixedShapeBatcher:
         self._pending_rows = 0
 
     # -- conversion cores ----------------------------------------------------
+    # f32→f16 value staging uses IEEE round-to-nearest with overflow
+    # saturating to ±inf — the same single-round semantics as the native
+    # fused kernels (fastparse.cc f32_to_f16). numpy warns on the overflow
+    # by default; the policy is chosen, so the warning is suppressed at
+    # the cast sites below via np.errstate(over='ignore').
+
     def _to_ell(self, blk: RowBlock, n_valid: int) -> Batch:
         spec = self.spec
         B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
@@ -149,7 +160,8 @@ class FixedShapeBatcher:
                 if blk.value is not None
                 else np.ones(blk.nnz, dtype=np.float32)
             )
-            values[:m, :k0] = vals.reshape(m, k0)
+            with np.errstate(over="ignore"):
+                values[:m, :k0] = vals.reshape(m, k0)
             nnz_kept = np.full(m, k0, dtype=np.int64)
         elif blk.nnz:
             row_ids = np.repeat(np.arange(m), nnz_per_row)
@@ -176,7 +188,8 @@ class FixedShapeBatcher:
                 if blk.value is not None
                 else np.ones(int(keep.sum()), dtype=np.float32)
             )
-            values[r, p] = vals
+            with np.errstate(over="ignore"):
+                values[r, p] = vals
             # per-row counts reflect dropped unfit features too
             nnz_kept = np.zeros(m, dtype=np.int64)
             np.add.at(nnz_kept, row_ids[keep], 1)
@@ -225,14 +238,16 @@ class FixedShapeBatcher:
             if uniform:
                 idx2 = idx.reshape(m, k0)
                 if k0 == 1 or np.all(idx2[:, 1:] > idx2[:, :-1]):
-                    x[np.arange(m)[:, None], idx2] = vals.reshape(m, k0)
+                    with np.errstate(over="ignore"):
+                        x[np.arange(m)[:, None], idx2] = vals.reshape(m, k0)
                 else:
                     uniform = False
             if not uniform:
                 row_ids = np.repeat(np.arange(m), nnz_per_row)
                 # duplicate indices within a row accumulate, matching
                 # sparse dot semantics
-                np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
+                with np.errstate(over="ignore"):
+                    np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
         labels = np.zeros(B, dtype=np.float32)
         labels[:m] = blk.label
         weights = np.zeros(B, dtype=np.float32)

@@ -52,6 +52,26 @@ _BOM = b"\xef\xbb\xbf"
 _MMAP_CHUNK = 32 << 20
 
 
+def _alloc_packed_slot(sections):
+    """One contiguous uint8 buffer + named views into it.
+
+    ``sections`` is [(name, shape, dtype)]; each section's offset is
+    8-byte aligned so the on-device bitcast unpack (pipeline.py) and the
+    host-side numpy views both see aligned data. Returns (buf, views).
+    """
+    offs = []
+    off = 0
+    for _name, shape, dtype in sections:
+        nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        offs.append((off, nb))
+        off += (nb + 7) & ~7
+    buf = np.zeros(off, dtype=np.uint8)
+    views = {}
+    for (o, nb), (name, shape, dtype) in zip(offs, sections):
+        views[name] = buf[o : o + nb].view(dtype).reshape(shape)
+    return buf, views
+
+
 def _plain_local_path(uri: str) -> Optional[str]:
     """Path if the URI is a single un-sharded local file, else None."""
     if any(ch in uri for ch in "?#;*"):
@@ -190,28 +210,33 @@ class FusedDenseLibSVMBatches:
             else io_split.create(uspec.uri, part_index, num_parts, type="text")
         )
         B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        self._ring: List[Tuple[np.ndarray, np.ndarray, np.ndarray]] = [
-            (
-                np.zeros((B, D), dtype=spec.value_dtype),
-                np.zeros(B, dtype=np.float32),
-                np.zeros(B, dtype=np.float32),
+        # each slot is one contiguous buffer (x | labels | weights views)
+        # so the staging pipeline can issue a single DMA per batch
+        self._ring: List[Tuple[np.ndarray, ...]] = []
+        for _ in range(max(2, ring)):
+            buf, v = _alloc_packed_slot(
+                [
+                    ("x", (B, D), spec.value_dtype),
+                    ("labels", (B,), np.float32),
+                    ("weights", (B,), np.float32),
+                ]
             )
-            for _ in range(max(2, ring))
-        ]
+            self._ring.append((v["x"], v["labels"], v["weights"], buf))
         self.ring_slots = len(self._ring)
         self._slot = 0
         self.rows_in = 0
         self.rows_out = 0
         self.truncated_nnz = 0
 
-    def _emit(self, x, labels, weights, n_valid: int) -> Batch:
+    def _emit(self, x, labels, weights, packed, n_valid: int) -> Batch:
         self.rows_out += n_valid
         if self.spec.overflow == "error" and self.truncated_nnz:
             raise Error(
                 f"{self.truncated_nnz} features outside [0, "
                 f"{self.spec.num_features}) with overflow='error'"
             )
-        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x)
+        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x,
+                     packed=packed)
 
     def __iter__(self) -> Iterator[Batch]:
         B = self.spec.batch_size
@@ -219,7 +244,7 @@ class FusedDenseLibSVMBatches:
             None if self._indexing_mode < 0
             else (1 if self._indexing_mode > 0 else 0)
         )
-        x, labels, weights = self._ring[self._slot]
+        x, labels, weights, packed = self._ring[self._slot]
         fill = 0
         first = True
         while True:
@@ -246,16 +271,16 @@ class FusedDenseLibSVMBatches:
                 self.rows_in += rows
                 self.truncated_nnz += trunc
                 if fill == B:
-                    yield self._emit(x, labels, weights, B)
+                    yield self._emit(x, labels, weights, packed, B)
                     self._slot = (self._slot + 1) % len(self._ring)
-                    x, labels, weights = self._ring[self._slot]
+                    x, labels, weights, packed = self._ring[self._slot]
                     fill = 0
         if fill:
             # zero-pad the tail batch; padding rows carry weight 0
             x[fill:] = 0
             labels[fill:] = 0
             weights[fill:] = 0
-            yield self._emit(x, labels, weights, fill)
+            yield self._emit(x, labels, weights, packed, fill)
             self._slot = (self._slot + 1) % len(self._ring)
 
     def close(self) -> None:
@@ -303,16 +328,22 @@ class FusedEllRowRecBatches:
                                  type="recordio")
         )
         B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
-        self._ring: List[Tuple[np.ndarray, ...]] = [
-            (
-                np.zeros((B, K), dtype=np.int32),
-                np.zeros((B, K), dtype=spec.value_dtype),
-                np.zeros(B, dtype=np.int32),
-                np.zeros(B, dtype=np.float32),
-                np.zeros(B, dtype=np.float32),
+        # one contiguous buffer per slot → one DMA per staged batch
+        self._ring: List[Tuple[np.ndarray, ...]] = []
+        for _ in range(max(2, ring)):
+            buf, v = _alloc_packed_slot(
+                [
+                    ("indices", (B, K), np.int32),
+                    ("values", (B, K), spec.value_dtype),
+                    ("nnz", (B,), np.int32),
+                    ("labels", (B,), np.float32),
+                    ("weights", (B,), np.float32),
+                ]
             )
-            for _ in range(max(2, ring))
-        ]
+            self._ring.append(
+                (v["indices"], v["values"], v["nnz"], v["labels"],
+                 v["weights"], buf)
+            )
         self.ring_slots = len(self._ring)
         self._slot = 0
         self.rows_in = 0
@@ -321,7 +352,7 @@ class FusedEllRowRecBatches:
         self.bad_records = 0
 
     def _emit(self, bufs, n_valid: int) -> Batch:
-        indices, values, nnz, labels, weights = bufs
+        indices, values, nnz, labels, weights, packed = bufs
         self.rows_out += n_valid
         if self.spec.overflow == "error" and self.truncated_nnz:
             raise Error(
@@ -330,14 +361,13 @@ class FusedEllRowRecBatches:
             )
         return Batch(
             labels=labels, weights=weights, n_valid=n_valid,
-            indices=indices, values=values, nnz=nnz,
+            indices=indices, values=values, nnz=nnz, packed=packed,
         )
 
     def _feed(self, chunk, off: int, fill: int):
         """Parse chunk[off:] into the current slot; returns updated
         (off, fill, made_progress)."""
-        bufs = self._ring[self._slot]
-        indices, values, nnz, labels, weights = bufs
+        indices, values, nnz, labels, weights, _packed = self._ring[self._slot]
         rows, consumed, trunc, bad = native.parse_rowrec_ell(
             chunk, off, indices, values, nnz, labels, weights, fill
         )
@@ -414,7 +444,7 @@ class FusedEllRowRecBatches:
 
     def _tail(self, fill: int) -> Iterator[Batch]:
         # zero-pad the final partial batch; padding rows carry weight 0
-        indices, values, nnz, labels, weights = self._ring[self._slot]
+        indices, values, nnz, labels, weights, _packed = self._ring[self._slot]
         indices[fill:] = 0
         values[fill:] = 0
         nnz[fill:] = 0
