@@ -59,7 +59,10 @@ def _packed_layout(batch: Batch):
     """(name, offset, nbytes, shape, dtype) per array, derived from the
     views' addresses inside ``batch.packed`` — or None if any array is
     not a view into it (then the per-array path must be used)."""
-    from numpy.lib.array_utils import byte_bounds
+    try:  # numpy >= 2.0 moved it; 1.x has the top-level name
+        from numpy.lib.array_utils import byte_bounds
+    except ImportError:
+        byte_bounds = np.byte_bounds  # type: ignore[attr-defined]
 
     packed = batch.packed
     base, end = byte_bounds(packed)

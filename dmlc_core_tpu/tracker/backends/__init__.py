@@ -10,9 +10,10 @@ from typing import Callable, Dict
 from ...utils.logging import Error
 
 
-def run_tracker_submit(args, launch_all, pscmd=None) -> None:
+def run_tracker_submit(args, launch_all, pscmd=None, abort_check=None) -> None:
     """The shared backend trailer: start the tracker (unless dry-run) and
-    hand worker envs to ``launch_all``."""
+    hand worker envs to ``launch_all``. ``abort_check`` lets a
+    Supervisor-backed launcher abort the rendezvous wait (supervisor.py)."""
     from .. import tracker
 
     tracker.submit(
@@ -22,6 +23,7 @@ def run_tracker_submit(args, launch_all, pscmd=None) -> None:
         pscmd=pscmd if pscmd is not None else " ".join(args.command),
         host_ip=args.host_ip or "auto",
         dry_run=args.dry_run,
+        abort_check=abort_check,
     )
 
 

@@ -32,12 +32,17 @@ def build_job_manifest(
              "DMLC_JOB_CLUSTER": "kubernetes"}.items()
         )
     ]
+    from ..supervisor import default_max_attempt
+
     return {
         "apiVersion": "batch/v1",
         "kind": "Job",
         "metadata": {"name": name, "namespace": namespace},
         "spec": {
-            "backoffLimit": 3,
+            # k8s' own controller is the supervisor here; the retry budget
+            # follows the same DMLC_MAX_ATTEMPT contract as the YARN AM
+            # (retries = total attempts - 1)
+            "backoffLimit": default_max_attempt() - 1,
             "template": {
                 "spec": {
                     "restartPolicy": "Never",

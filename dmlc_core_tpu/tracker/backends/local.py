@@ -1,9 +1,14 @@
-"""Local backend: threads spawning subprocesses with retry.
+"""Local backend: supervised subprocesses with retry + relaunch.
 
-Reference: tracker/dmlc_tracker/local.py. Roles by index (first
-num_workers are workers, rest servers, local.py:66-73); failed commands
-retry up to --local-num-attempt times, attempt count exported as
-DMLC_NUM_ATTEMPT (local.py:26-49; the SURVEY §5.3 process-restart story).
+Reference: tracker/dmlc_tracker/local.py (roles by index — first
+num_workers are workers, rest servers, local.py:66-73; attempt count
+exported as DMLC_NUM_ATTEMPT, local.py:26-49). Failure handling goes
+beyond the reference's per-task retry loop: all tasks run under the
+shared Supervisor (supervisor.py), which gives the local cluster the
+YARN ApplicationMaster's semantics — per-task attempt budgets
+(DMLC_MAX_ATTEMPT / --local-num-attempt), job abort past the budget, and
+relaunched workers recovering their rank via the tracker's ``recover``
+path.
 """
 
 from __future__ import annotations
@@ -11,51 +16,43 @@ from __future__ import annotations
 import logging
 import os
 import subprocess
-import sys
-import threading
 from typing import Dict, List
 
-from .. import tracker
+from ..supervisor import Supervisor, default_max_attempt
 from . import run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
 
 
-def exec_cmd(
+def make_launcher(
     cmd: List[str],
-    num_attempt: int,
-    role: str,
-    taskid: int,
+    nworker: int,
     pass_env: Dict[str, object],
-) -> None:
+    cluster: str = "local",
+):
+    """Popen factory for the Supervisor: role from task index, DMLC env
+    contract exported per attempt."""
     if "/" not in cmd[0] and os.path.exists(cmd[0]):
         cmd = ["./" + cmd[0]] + cmd[1:]
-    env = os.environ.copy()
-    for k, v in pass_env.items():
-        env[k] = str(v)
-    env["DMLC_TASK_ID"] = str(taskid)
-    env["DMLC_ROLE"] = role
-    env["DMLC_JOB_CLUSTER"] = "local"
-    num_retry = int(env.get("DMLC_NUM_ATTEMPT", num_attempt))
-    trial = 0
-    while True:
-        env["DMLC_NUM_ATTEMPT"] = str(trial)
-        ret = subprocess.call(
+
+    def launch(task_id: int, host: str, attempt: int) -> subprocess.Popen:
+        env = os.environ.copy()
+        for k, v in pass_env.items():
+            env[str(k)] = str(v)
+        env["DMLC_TASK_ID"] = str(task_id)
+        env["DMLC_ROLE"] = "worker" if task_id < nworker else "server"
+        env["DMLC_JOB_CLUSTER"] = cluster
+        env["DMLC_NUM_ATTEMPT"] = str(attempt)
+        return subprocess.Popen(
             " ".join(cmd), shell=True, executable="/bin/bash", env=env
         )
-        if ret == 0:
-            logger.debug("task %d exited with 0", taskid)
-            return
-        trial += 1
-        num_retry -= 1
-        if num_retry < 0:
-            raise RuntimeError(
-                f"nonzero return code={ret} on task {taskid}: {cmd}"
-            )
-        logger.info("task %d failed (ret=%d); retry %d", taskid, ret, trial)
+
+    return launch
 
 
 def submit(args) -> None:
+    checks: List = []
+
     def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
         if args.dry_run:
             for i in range(nworker + nserver):
@@ -63,13 +60,19 @@ def submit(args) -> None:
                 print(f"[dry-run] local task {i} role={role}: "
                       f"{' '.join(args.command)}")
             return
-        for i in range(nworker + nserver):
-            role = "worker" if i < nworker else "server"
-            t = threading.Thread(
-                target=exec_cmd,
-                args=(list(args.command), args.local_num_attempt, role, i, envs),
-                daemon=True,
-            )
-            t.start()
+        # --local-num-attempt retries == max_attempt total runs - 1
+        # (reference local.py retry budget); DMLC_MAX_ATTEMPT wins if set.
+        # localhost is one shared host, not a failure domain — per-task
+        # budgets apply but blacklisting is disabled.
+        sup = Supervisor(
+            make_launcher(list(args.command), nworker, envs),
+            hosts=["localhost"],
+            max_attempt=default_max_attempt(args.local_num_attempt + 1),
+            host_fail_limit=float("inf"),
+        )
+        checks.append(sup.run_in_thread(nworker + nserver, "local-supervisor"))
 
-    run_tracker_submit(args, launch_all)
+    run_tracker_submit(
+        args, launch_all,
+        abort_check=lambda: checks[0]() if checks else None,
+    )

@@ -18,10 +18,9 @@ from __future__ import annotations
 
 import logging
 import subprocess
-import threading
 from typing import Dict, List
 
-from .. import tracker
+from ..supervisor import Supervisor, default_max_attempt
 from . import format_env_exports, run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
@@ -35,6 +34,7 @@ def build_worker_command(
     command: List[str],
     envs: Dict[str, object],
     coordinator: str,
+    attempt: int = 0,
 ) -> str:
     """The remote command string one pod host runs."""
     exports = dict(envs)
@@ -42,6 +42,7 @@ def build_worker_command(
         DMLC_ROLE="worker",
         DMLC_TASK_ID=worker_id,
         DMLC_JOB_CLUSTER="tpu-pod",
+        DMLC_NUM_ATTEMPT=attempt,
         # jax.distributed.initialize() picks these up (or the user passes
         # them explicitly); rank == pod host index == InputSplit part.
         JAX_COORDINATOR_ADDRESS=f"{coordinator}:{COORDINATOR_PORT}",
@@ -77,24 +78,49 @@ def submit(args) -> None:
             "PS data plane (drop --num-servers)"
         )
 
+    checks: List = []
+
     def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
         coordinator = envs.get("DMLC_TRACKER_URI", "localhost")
-        for i in range(nworker):
-            remote = build_worker_command(
-                i, nworker, list(args.command), envs, str(coordinator)
-            )
-            cmd = build_gcloud_ssh(
-                args.tpu_name or "<tpu-name>",
-                args.tpu_zone,
-                args.tpu_project,
-                i,
-                remote,
-            )
-            if args.dry_run:
+        if args.dry_run:
+            for i in range(nworker):
+                remote = build_worker_command(
+                    i, nworker, list(args.command), envs, str(coordinator)
+                )
+                cmd = build_gcloud_ssh(
+                    args.tpu_name or "<tpu-name>",
+                    args.tpu_zone,
+                    args.tpu_project,
+                    i,
+                    remote,
+                )
                 print(f"[dry-run] {' '.join(cmd)}")
-                continue
-            threading.Thread(
-                target=subprocess.check_call, args=(cmd,), daemon=True
-            ).start()
+            return
 
-    run_tracker_submit(args, launch_all, pscmd="")
+        def launch(task_id: int, host: str, attempt: int) -> subprocess.Popen:
+            remote = build_worker_command(
+                task_id, nworker, list(args.command), envs,
+                str(coordinator), attempt,
+            )
+            return subprocess.Popen(
+                build_gcloud_ssh(
+                    args.tpu_name, args.tpu_zone, args.tpu_project,
+                    task_id, remote,
+                )
+            )
+
+        # fixed placement: JAX process i must run on pod host i, so a
+        # blacklisted host aborts instead of re-placing (documented
+        # divergence from the YARN AM's free container placement)
+        sup = Supervisor(
+            launch,
+            hosts=[f"pod-host-{i}" for i in range(nworker)],
+            max_attempt=default_max_attempt(),
+            allow_replacement=False,
+        )
+        checks.append(sup.run_in_thread(nworker, "tpu-pod-supervisor"))
+
+    run_tracker_submit(
+        args, launch_all, pscmd="",
+        abort_check=lambda: checks[0]() if checks else None,
+    )

@@ -1,0 +1,195 @@
+"""Cluster-level fault tolerance: supervised relaunch with blacklisting.
+
+The capability the reference implements only inside its Java YARN
+ApplicationMaster (reference
+tracker/yarn/src/.../ApplicationMaster.java:537-569 ``handleFailure``:
+failed containers are re-requested up to ``DMLC_MAX_ATTEMPT`` — default 3,
+``:76,212`` — the failing node is blacklisted, and the job aborts past the
+limit). Here it is a backend-agnostic supervisor the local / tpu-pod /
+kubernetes launchers share, so every cluster gets the same semantics:
+
+- each task gets at most ``max_attempt`` total runs; one more failure
+  aborts the whole job (all still-running tasks are killed);
+- a host that accumulates ``host_fail_limit`` failures is blacklisted and
+  its tasks move to healthy hosts (when the backend allows re-placement —
+  TPU pods pin task i to pod host i, so for them a blacklisted host means
+  abort, documented divergence);
+- every (re)launch exports ``DMLC_NUM_ATTEMPT`` (the attempt index, same
+  env the reference local launcher uses, reference local.py:26-49), so a
+  restarted worker can reconnect with ``cmd='recover'`` and the tracker
+  re-issues its previous rank (tracker.py recover path, SURVEY §5.3).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Supervisor", "JobAborted", "default_max_attempt"]
+
+logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+class JobAborted(RuntimeError):
+    """The job exceeded its failure budget (reference AM abort path)."""
+
+
+def default_max_attempt(fallback: int = 3) -> int:
+    """DMLC_MAX_ATTEMPT from the environment (reference AM reads the same
+    variable, ApplicationMaster.java:212), else ``fallback``."""
+    try:
+        return max(1, int(os.environ.get("DMLC_MAX_ATTEMPT", fallback)))
+    except ValueError:
+        return max(1, fallback)
+
+
+@dataclass
+class _Running:
+    task_id: int
+    host: str
+    attempt: int
+    proc: "object"  # Popen-like: poll(), kill(), wait()
+
+
+class Supervisor:
+    """Launch ``n_tasks`` processes and keep them alive through failures.
+
+    ``launch(task_id, host, attempt)`` must start the task and return a
+    Popen-like handle (``poll() -> Optional[int]``, ``kill()``,
+    ``wait()``). The supervisor owns placement, retry budgets, and the
+    blacklist; backends own command construction.
+    """
+
+    def __init__(
+        self,
+        launch: Callable[[int, str, int], object],
+        hosts: Sequence[str] = ("localhost",),
+        max_attempt: Optional[int] = None,
+        host_fail_limit: Optional[int] = None,
+        allow_replacement: bool = True,
+        poll_interval: float = 0.05,
+    ) -> None:
+        self.launch = launch
+        self.hosts = list(hosts)
+        self.max_attempt = (
+            max_attempt if max_attempt is not None else default_max_attempt()
+        )
+        # a host is unhealthy after this many failures on it (the reference
+        # AM blacklists after a single container failure on the node; one
+        # failure per host is a tight default when tasks can move, so the
+        # default budget follows max_attempt instead). float('inf')
+        # disables blacklisting — right when the host set is not a real
+        # failure domain (a single localhost shared by every task).
+        self.host_fail_limit = (
+            host_fail_limit if host_fail_limit is not None else self.max_attempt
+        )
+        self.allow_replacement = allow_replacement
+        self._thread: Optional[threading.Thread] = None
+        self.poll_interval = poll_interval
+        self.failures: Dict[int, int] = {}  # task_id -> failed runs
+        self.host_failures: Dict[str, int] = {}
+        self.blacklist: set = set()
+        self.placement: Dict[int, str] = {}
+        self.relaunches = 0
+        self.error: Optional[BaseException] = None
+
+    # -- placement -----------------------------------------------------------
+    def _healthy_hosts(self) -> List[str]:
+        return [h for h in self.hosts if h not in self.blacklist]
+
+    def _pick_host(self, task_id: int, prev: Optional[str]) -> str:
+        healthy = self._healthy_hosts()
+        if prev is not None and prev in healthy:
+            return prev
+        if prev is not None and not self.allow_replacement:
+            raise JobAborted(
+                f"host {prev!r} is blacklisted and task {task_id} cannot "
+                "be re-placed on this backend"
+            )
+        if not healthy:
+            raise JobAborted("every host is blacklisted")
+        return healthy[task_id % len(healthy)]
+
+    # -- failure accounting (reference handleFailure) ------------------------
+    def _handle_failure(self, r: _Running, returncode: int) -> _Running:
+        self.failures[r.task_id] = self.failures.get(r.task_id, 0) + 1
+        self.host_failures[r.host] = self.host_failures.get(r.host, 0) + 1
+        if self.host_failures[r.host] >= self.host_fail_limit:
+            if r.host not in self.blacklist:
+                logger.warning("blacklisting host %s", r.host)
+            self.blacklist.add(r.host)
+        if self.failures[r.task_id] >= self.max_attempt:
+            raise JobAborted(
+                f"task {r.task_id} failed {self.failures[r.task_id]} times "
+                f"(returncode={returncode}, max_attempt={self.max_attempt})"
+            )
+        # pass the previous host as-is: _pick_host keeps it when healthy,
+        # re-places when blacklisted, and aborts when the backend pins
+        # placement (allow_replacement=False)
+        host = self._pick_host(r.task_id, r.host)
+        attempt = self.failures[r.task_id]
+        logger.info(
+            "task %d failed on %s (ret=%d); relaunch attempt %d on %s",
+            r.task_id, r.host, returncode, attempt, host,
+        )
+        self.relaunches += 1
+        self.placement[r.task_id] = host
+        return _Running(r.task_id, host, attempt, self.launch(r.task_id, host, attempt))
+
+    # -- main loop -----------------------------------------------------------
+    def run(self, n_tasks: int) -> None:
+        """Blocks until every task has exited 0; raises JobAborted past the
+        failure budget (killing whatever still runs). Any raised error is
+        also recorded on ``self.error`` for callers running this on a
+        thread."""
+        running: Dict[int, _Running] = {}
+        try:
+            for tid in range(n_tasks):
+                host = self._pick_host(tid, None)
+                self.placement[tid] = host
+                running[tid] = _Running(tid, host, 0, self.launch(tid, host, 0))
+            while running:
+                finished = [
+                    (tid, r.proc.poll())
+                    for tid, r in running.items()
+                    if r.proc.poll() is not None
+                ]
+                if not finished:
+                    time.sleep(self.poll_interval)
+                    continue
+                for tid, ret in finished:
+                    r = running.pop(tid)
+                    if ret == 0:
+                        logger.debug("task %d finished", tid)
+                        continue
+                    running[tid] = self._handle_failure(r, int(ret))
+        except BaseException as e:
+            self.error = e
+            for r in running.values():
+                try:
+                    r.proc.kill()
+                    r.proc.wait()
+                except OSError:
+                    pass
+            raise
+
+    def run_in_thread(
+        self, n_tasks: int, label: str = "supervisor"
+    ) -> Callable[[], Optional[BaseException]]:
+        """Run on a daemon thread; returns an error-check callable suited
+        for tracker.submit's ``abort_check`` (backends share this instead
+        of each re-implementing the holder/thread/lambda plumbing)."""
+
+        def body() -> None:
+            try:
+                self.run(n_tasks)
+            except Exception:
+                logger.exception("%s aborted the job", label)
+
+        self._thread = threading.Thread(target=body, daemon=True, name=label)
+        self._thread.start()
+        return lambda: self.error

@@ -485,6 +485,7 @@ def submit(
     host_ip: str = "auto",
     pscmd: Optional[str] = None,
     dry_run: bool = False,
+    abort_check: Optional[Callable[[], Optional[BaseException]]] = None,
 ) -> None:
     """Start the right tracker, hand worker envs to the cluster-specific
     launcher, wait for completion (reference tracker.submit,
@@ -492,7 +493,12 @@ def submit(
 
     ``dry_run`` skips the tracker entirely (no rendezvous to wait on) and
     hands fun_submit placeholder tracker envs so backends can print their
-    launch commands."""
+    launch commands.
+
+    ``abort_check`` (from backends running a Supervisor) is polled while
+    waiting on the rendezvous; a non-None error aborts the wait and
+    re-raises instead of hanging on workers that will never report
+    shutdown (the reference job simply wedges here)."""
     if n_servers == 0:
         pscmd = None
     envs = worker_env(n_workers, n_servers)
@@ -509,11 +515,22 @@ def submit(
         rabit.start(n_workers)
         if rabit.alive():
             fun_submit(n_workers, n_servers, envs)
-        rabit.join()
+        while rabit.alive():
+            time.sleep(0.1)
+            if abort_check is not None:
+                err = abort_check()
+                if err is not None:
+                    rabit.close()  # accept() raises; tracker thread exits
+                    raise err
         rabit.close()
     else:
         ps = PSTracker(host_ip=ip, cmd=pscmd, envs=envs)
         envs.update(ps.worker_envs())
         if ps.alive():
             fun_submit(n_workers, n_servers, envs)
-        ps.join()
+        while ps.alive():
+            time.sleep(0.1)
+            if abort_check is not None:
+                err = abort_check()
+                if err is not None:
+                    raise err

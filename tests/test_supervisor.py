@@ -1,0 +1,204 @@
+"""Cluster fault tolerance: the Supervisor (the YARN-AM capability).
+
+Unit tier drives the retry/blacklist/abort state machine with fake
+processes (reference handleFailure semantics,
+ApplicationMaster.java:537-569); the end-to-end tier kills a real worker
+mid-job under the local backend and watches it relaunch, reclaim its
+rank through the tracker's jobid memo + recover path, and finish."""
+
+import os
+import sys
+import time
+
+import pytest
+
+from dmlc_core_tpu.tracker.supervisor import (
+    JobAborted,
+    Supervisor,
+    default_max_attempt,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeProc:
+    """Popen-alike whose exit code is scripted."""
+
+    def __init__(self, returncode):
+        self.returncode = returncode
+        self.killed = False
+
+    def poll(self):
+        return self.returncode
+
+    def kill(self):
+        self.killed = True
+        self.returncode = -9
+
+    def wait(self):
+        return self.returncode
+
+
+def test_default_max_attempt(monkeypatch):
+    monkeypatch.delenv("DMLC_MAX_ATTEMPT", raising=False)
+    assert default_max_attempt() == 3
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "5")
+    assert default_max_attempt() == 5
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "junk")
+    assert default_max_attempt(4) == 4
+
+
+def test_relaunch_until_success():
+    """A task failing twice inside a 3-attempt budget is relaunched with
+    an incrementing attempt index and the job completes."""
+    log = []
+
+    def launch(task_id, host, attempt):
+        log.append((task_id, host, attempt))
+        # task 1 fails on attempts 0 and 1, succeeds on 2
+        if task_id == 1 and attempt < 2:
+            return FakeProc(1)
+        return FakeProc(0)
+
+    sup = Supervisor(launch, hosts=["h0"], max_attempt=3, poll_interval=0)
+    sup.run(2)
+    assert sup.relaunches == 2
+    assert sup.failures == {1: 2}
+    assert [(h, a) for (t, h, a) in log if t == 1] == [
+        ("h0", 0), ("h0", 1), ("h0", 2),
+    ]
+
+
+def test_abort_past_budget_kills_survivors():
+    """One more failure than max_attempt aborts the job and kills every
+    still-running task (reference AM abort, ApplicationMaster.java:564)."""
+    hang = FakeProc(None)  # never exits
+
+    def launch(task_id, host, attempt):
+        return FakeProc(1) if task_id == 0 else hang
+
+    sup = Supervisor(launch, hosts=["h0"], max_attempt=2, poll_interval=0)
+    with pytest.raises(JobAborted, match="task 0 failed 2 times"):
+        sup.run(2)
+    assert hang.killed
+    assert isinstance(sup.error, JobAborted)
+
+
+def test_blacklisted_host_moves_task():
+    """Per-host failure accounting blacklists the bad host and re-places
+    its task on a healthy one (reference node blacklist,
+    ApplicationMaster.java:544-552)."""
+    log = []
+
+    def launch(task_id, host, attempt):
+        log.append((task_id, host, attempt))
+        return FakeProc(1 if host == "bad" else 0)
+
+    sup = Supervisor(
+        launch, hosts=["bad", "good"], max_attempt=3,
+        host_fail_limit=1, poll_interval=0,
+    )
+    sup.run(2)  # task 0 -> bad (fails, moves), task 1 -> good
+    assert "bad" in sup.blacklist
+    assert sup.placement[0] == "good"
+    assert ("bad" not in {h for (_t, h, _a) in log[-1:]})
+
+
+def test_pinned_placement_aborts_on_blacklist():
+    """allow_replacement=False (tpu-pod: JAX process i must run on pod
+    host i) turns a blacklisted host into a job abort."""
+
+    def launch(task_id, host, attempt):
+        return FakeProc(1 if task_id == 0 else None)
+
+    sup = Supervisor(
+        launch, hosts=["p0", "p1"], max_attempt=5,
+        host_fail_limit=1, allow_replacement=False, poll_interval=0,
+    )
+    with pytest.raises(JobAborted, match="cannot be re-placed"):
+        sup.run(2)
+
+
+def test_all_hosts_blacklisted_aborts():
+    def launch(task_id, host, attempt):
+        return FakeProc(1)
+
+    sup = Supervisor(
+        launch, hosts=["h0"], max_attempt=10,
+        host_fail_limit=1, poll_interval=0,
+    )
+    with pytest.raises(JobAborted, match="every host is blacklisted"):
+        sup.run(1)
+
+
+# -- end to end over the local backend ---------------------------------------
+
+CRASHY_WORKER = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from dmlc_core_tpu.tracker.client import RabitWorker
+
+out = {out!r}
+task = os.environ["DMLC_TASK_ID"]
+attempt = int(os.environ["DMLC_NUM_ATTEMPT"])
+
+def wait_for(path, deadline=30.0):
+    end = time.time() + deadline
+    while not os.path.exists(path):
+        if time.time() > end:
+            raise SystemExit("timeout waiting for " + path)
+        time.sleep(0.02)
+
+w = RabitWorker()
+rank = w.start()
+with open(out + "task%s_attempt%d" % (task, attempt), "w") as f:
+    f.write(str(rank))
+if task == "1" and attempt == 0:
+    # die mid-job, after rendezvous: the supervisor must relaunch us
+    open(out + "crashed", "w").close()
+    os._exit(7)
+if task == "0":
+    # stay alive through the peer's crash, then re-rendezvous so the
+    # recovered worker can wire its links (rabit recover contract)
+    wait_for(out + "crashed")
+    w.close()
+    w2 = RabitWorker()
+    rank = w2.start(recover_rank=rank)
+    w2.shutdown()
+else:
+    w.shutdown()
+"""
+
+
+def test_worker_killed_mid_job_relaunches_with_same_rank(tmp_path):
+    """VERDICT r2 'done' criterion: kill a worker mid-job, see the
+    supervisor relaunch it and the tracker re-issue the same rank."""
+    out = str(tmp_path / "s_")
+    script = tmp_path / "crashy.py"
+    script.write_text(CRASHY_WORKER.format(repo=REPO, out=out))
+    import importlib
+
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main(
+        ["--cluster", "local", "--num-workers", "2",
+         "--local-num-attempt", "2",
+         "--host-ip", "127.0.0.1", sys.executable, str(script)]
+    )
+    first = open(out + "task1_attempt0").read()
+    second = open(out + "task1_attempt1").read()
+    assert first == second, "relaunched worker got a different rank"
+    assert os.path.exists(out + "task0_attempt0")
+
+
+def test_job_abort_propagates_from_submit(tmp_path):
+    """A task that exhausts its budget must abort submit() instead of
+    wedging the rendezvous wait."""
+    import importlib
+
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    with pytest.raises(JobAborted):
+        submit_mod.main(
+            ["--cluster", "local", "--num-workers", "1",
+             "--local-num-attempt", "1",
+             "--host-ip", "127.0.0.1", "false"]
+        )
