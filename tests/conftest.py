@@ -17,6 +17,12 @@ if "xla_force_host_platform_device_count" not in flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: spawns subprocesses with fresh jax imports"
+    )
+
 # The axon TPU plugin in this image force-registers itself and wins over
 # JAX_PLATFORMS env alone; the config update below reliably pins the test
 # session to the virtual 8-device CPU backend.
