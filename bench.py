@@ -201,6 +201,51 @@ def _make_higgs_stream(value_dtype: str):
     return dense_batches(DATA, spec), "x", DATA
 
 
+CSV_DATA = os.environ.get(
+    "BENCH_CSV_DATA", f"/tmp/dmlc_tpu_bench_higgs_{N_ROWS}.csv"
+)
+
+
+def ensure_csv_data() -> None:
+    """HIGGS-like dense CSV (label column 0 + 28 feature columns)."""
+    if os.path.exists(CSV_DATA) and os.path.getsize(CSV_DATA) > 0:
+        return
+    rng = np.random.default_rng(21)
+    tmp = CSV_DATA + ".tmp"
+    with open(tmp, "w") as f:
+        chunk = 20000
+        for start in range(0, N_ROWS, chunk):
+            n = min(chunk, N_ROWS - start)
+            vals = rng.normal(size=(n, N_FEATURES))
+            labels = rng.integers(0, 2, n)
+            f.write(
+                "".join(
+                    "%d,%s\n" % (
+                        labels[i],
+                        ",".join(f"{v:.6f}" for v in vals[i]),
+                    )
+                    for i in range(n)
+                )
+            )
+    os.replace(tmp, CSV_DATA)
+
+
+def _make_csv_stream(value_dtype: str):
+    from dmlc_core_tpu.staging import BatchSpec, dense_batches
+
+    spec = BatchSpec(
+        batch_size=BATCH,
+        layout="dense",
+        num_features=N_FEATURES,
+        value_dtype=np.dtype(value_dtype),
+    )
+    return (
+        dense_batches(CSV_DATA + "?format=csv&label_column=0", spec),
+        "x",
+        CSV_DATA,
+    )
+
+
 def _make_rec_stream(value_dtype: str):
     from dmlc_core_tpu.staging import BatchSpec, ell_batches
 
@@ -252,6 +297,7 @@ def main() -> None:
     ensure_native()
     ensure_data()
     ensure_rec_data()
+    ensure_csv_data()
     from dmlc_core_tpu.data import native
 
     # headline (f16) metrics first: the host↔device link on shared/tunneled
@@ -260,6 +306,7 @@ def main() -> None:
     value = round(best_of(EPOCHS, _make_higgs_stream, "float16")["rows_per_sec"], 1)
     rec_best = best_of(EPOCHS, _make_rec_stream, "float16")
     n32 = max(1, EPOCHS - 1)
+    csv_best = best_of(n32, _make_csv_stream, "float16")
     f32 = round(best_of(n32, _make_higgs_stream, "float32")["rows_per_sec"], 1)
     rec_f32 = best_of(n32, _make_rec_stream, "float32")["rows_per_sec"]
     print(
@@ -277,9 +324,13 @@ def main() -> None:
                     rec_best["mb_per_sec"], 1
                 ),
                 "recordio_f32_rows_per_sec": round(rec_f32, 1),
+                "csv_staged_rows_per_sec": round(
+                    csv_best["rows_per_sec"], 1
+                ),
                 "native": native.AVAILABLE,
                 "fused_dense_kernel": native.HAS_DENSE,
                 "fused_ell_kernel": native.HAS_ELL,
+                "fused_csv_kernel": native.HAS_CSV_DENSE,
                 "host_cpus": os.cpu_count(),
             }
         )

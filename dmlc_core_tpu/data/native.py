@@ -26,18 +26,21 @@ __all__ = [
     "AVAILABLE",
     "HAS_DENSE",
     "HAS_ELL",
+    "HAS_CSV_DENSE",
     "parse_libsvm",
     "parse_csv",
     "parse_libfm",
     "parse_libsvm_dense",
+    "parse_csv_dense",
     "parse_rowrec_ell",
     "source_hash",
     "load",
 ]
 
 AVAILABLE = False
-HAS_DENSE = False  # fused libsvm->dense-batch kernel present in the .so
-HAS_ELL = False    # fused recordio rowrec->ELL-batch kernel present
+HAS_DENSE = False      # fused libsvm->dense-batch kernel present in the .so
+HAS_ELL = False        # fused recordio rowrec->ELL-batch kernel present
+HAS_CSV_DENSE = False  # fused csv->dense-batch kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -91,6 +94,18 @@ class _EllResult(ctypes.Structure):
     ]
 
 
+class _CsvDenseResult(ctypes.Structure):
+    """Mirrors native/fastparse.cc struct CsvDenseResult."""
+
+    _fields_ = [
+        ("rows_written", ctypes.c_int64),
+        ("bytes_consumed", ctypes.c_int64),
+        ("truncated", ctypes.c_int64),
+        ("has_cr", ctypes.c_int64),
+        ("bad_lines", ctypes.c_int64),
+    ]
+
+
 def load(path: Optional[str] = None, force: bool = False) -> bool:
     """Load the native library (idempotent). Returns availability.
 
@@ -98,13 +113,13 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
     an in-session rebuild (the rebuilt file is a new inode, so dlopen
     returns a fresh handle; the old one is left to the process lifetime).
     """
-    global AVAILABLE, HAS_DENSE, HAS_ELL, _LIB
+    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, _LIB
     with _LOCK:
         if _LIB is not None and not force:
             return AVAILABLE
         if force:
             _LIB = None
-            AVAILABLE = HAS_DENSE = HAS_ELL = False
+            AVAILABLE = HAS_DENSE = HAS_ELL = HAS_CSV_DENSE = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES
@@ -136,6 +151,16 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
                     ctypes.POINTER(_DenseResult)]
                 lib.dmlc_parse_libsvm_dense.restype = None
                 HAS_DENSE = True
+            # fused csv->dense kernel: absent in older builds
+            if hasattr(lib, "dmlc_parse_csv_dense"):
+                lib.dmlc_parse_csv_dense.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.POINTER(_CsvDenseResult)]
+                lib.dmlc_parse_csv_dense.restype = None
+                HAS_CSV_DENSE = True
             # fused recordio rowrec->ELL kernel: absent in older builds
             if hasattr(lib, "dmlc_parse_rowrec_ell"):
                 lib.dmlc_parse_rowrec_ell.argtypes = [
@@ -286,6 +311,57 @@ def parse_libsvm_dense(
         ctypes.byref(res),
     )
     return res.rows_written, res.bytes_consumed, res.truncated, res.has_cr
+
+
+def parse_csv_dense(
+    chunk,
+    offset: int,
+    delimiter: int,
+    label_column: int,
+    weight_column: int,
+    x: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+    cr_hint: int = -1,
+) -> Optional[Tuple[int, int, int, int, int]]:
+    """Fused csv parse → dense batch rows (same buffer contract as
+    ``parse_libsvm_dense``). ``weight_column`` -1 = none. Returns
+    (rows_written, bytes_consumed, truncated, has_cr, bad_lines) — a
+    nonzero ``bad_lines`` means a non-empty line had no delimiter, which
+    the generic CSVParser treats as a malformed file. None if missing."""
+    if not HAS_CSV_DENSE:
+        return None
+    from ..utils.logging import check
+
+    mem = np.frombuffer(chunk, dtype=np.uint8)
+    check(x.flags.c_contiguous and x.dtype in (np.float32, np.float16),
+          "x must be C-contiguous float32/float16")
+    check(labels.flags.c_contiguous and labels.dtype == np.float32
+          and weights.flags.c_contiguous and weights.dtype == np.float32,
+          "labels/weights must be C-contiguous float32")
+    capacity, D = x.shape
+    check(len(labels) >= capacity and len(weights) >= capacity,
+          "labels/weights shorter than x capacity")
+    res = _CsvDenseResult()
+    _LIB.dmlc_parse_csv_dense(
+        ctypes.c_void_p(mem.ctypes.data + offset),
+        ctypes.c_int64(mem.size - offset),
+        ctypes.c_int32(delimiter),
+        ctypes.c_int32(label_column),
+        ctypes.c_int32(weight_column),
+        ctypes.c_int64(D),
+        ctypes.c_int32(1 if x.dtype == np.float16 else 0),
+        ctypes.c_void_p(x.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.c_int32(cr_hint),
+        ctypes.byref(res),
+    )
+    return (res.rows_written, res.bytes_consumed, res.truncated,
+            res.has_cr, res.bad_lines)
 
 
 def parse_rowrec_ell(

@@ -7,6 +7,7 @@ bounded in-flight depth, optionally sharded over a jax Mesh data axis.
 
 from .batcher import Batch, BatchSpec, FixedShapeBatcher
 from .fused import (
+    FusedDenseCSVBatches,
     FusedDenseLibSVMBatches,
     FusedEllRowRecBatches,
     dense_batches,
@@ -18,6 +19,7 @@ __all__ = [
     "Batch",
     "BatchSpec",
     "FixedShapeBatcher",
+    "FusedDenseCSVBatches",
     "FusedDenseLibSVMBatches",
     "FusedEllRowRecBatches",
     "StagingPipeline",

@@ -42,6 +42,7 @@ from ..utils.logging import Error, check
 from .batcher import Batch, BatchSpec
 
 __all__ = [
+    "FusedDenseCSVBatches",
     "FusedDenseLibSVMBatches",
     "FusedEllRowRecBatches",
     "dense_batches",
@@ -169,14 +170,15 @@ def _probe_base(chunk) -> int:
     return 1 if (min_idx is not None and min_idx > 0) else 0
 
 
-class FusedDenseLibSVMBatches:
-    """Iterator of dense Batches over a libsvm URI via the fused kernel.
+class _FusedDenseTextBatches:
+    """Shared machinery for fused text → dense-batch producers.
 
-    Yields Batch views into a ring of ``ring`` preallocated buffer sets;
-    a yielded batch stays valid until ``ring - 1`` further batches have
-    been produced (size the ring above the staging pipeline's
-    prefetch + in-flight depth; the default 8 covers StagingPipeline's
-    defaults with margin).
+    Yields Batch views into a ring of ``ring`` preallocated buffer sets
+    (each one contiguous buffer: x | labels | weights views, so the
+    staging pipeline can issue a single DMA per batch); a yielded batch
+    stays valid until ``ring_slots - 1`` further batches have been
+    produced. Subclasses implement ``_parse`` (one resumable native call)
+    and optionally ``_first_chunk``.
     """
 
     def __init__(
@@ -185,33 +187,19 @@ class FusedDenseLibSVMBatches:
         spec: BatchSpec,
         part_index: int = 0,
         num_parts: int = 1,
-        indexing_mode: int = 0,
         ring: int = 8,
     ) -> None:
-        check(native.HAS_DENSE, "native fused kernel not loaded")
         check(spec.layout == "dense", "fused path requires layout='dense'")
         check(spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16)),
               f"fused path supports f32/f16 values, not {spec.value_dtype}")
         self.spec = spec
-        uspec = URISpec(uri, part_index, num_parts)
-        if "indexing_mode" in uspec.args:
-            # per-dataset options ride the URI (reference uri_spec.h), same
-            # as the generic LibSVMParser path
-            indexing_mode = int(uspec.args["indexing_mode"])
-        if indexing_mode < 0 and num_parts > 1:
-            # auto mode must resolve identically on every shard: probe the
-            # head of the file, not this shard's mid-file first chunk
-            indexing_mode = _probe_base_from_uri(uspec.uri)
-        self._indexing_mode = indexing_mode
-        local = _plain_local_path(uspec.uri) if num_parts == 1 else None
-        self._split = (
-            _MmapChunks(local)
-            if local is not None
-            else io_split.create(uspec.uri, part_index, num_parts, type="text")
-        )
+        self.uspec = URISpec(uri, part_index, num_parts)
+        # the split opens lazily (first iteration): subclass __init__s
+        # still validate URI args, and a validation failure must not leak
+        # an open mmap/fd
+        self._split_args = (part_index, num_parts)
+        self._split = None
         B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        # each slot is one contiguous buffer (x | labels | weights views)
-        # so the staging pipeline can issue a single DMA per batch
         self._ring: List[Tuple[np.ndarray, ...]] = []
         for _ in range(max(2, ring)):
             buf, v = _alloc_packed_slot(
@@ -228,6 +216,19 @@ class FusedDenseLibSVMBatches:
         self.rows_out = 0
         self.truncated_nnz = 0
 
+    # -- subclass hooks ------------------------------------------------------
+    def _first_chunk(self, chunk, off: int) -> int:
+        """Inspect the first chunk (BOM, format probes); returns new off."""
+        if bytes(memoryview(chunk)[:3]) == _BOM:
+            off += 3  # UTF-8 BOM skip (text_parser.h:81-95)
+        return off
+
+    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+        """One resumable native call → (rows, consumed, cr_hint), updating
+        truncation/error counters on self."""
+        raise NotImplementedError
+
+    # -- shared loop ---------------------------------------------------------
     def _emit(self, x, labels, weights, packed, n_valid: int) -> Batch:
         self.rows_out += n_valid
         if self.spec.overflow == "error" and self.truncated_nnz:
@@ -238,38 +239,46 @@ class FusedDenseLibSVMBatches:
         return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x,
                      packed=packed)
 
+    def _ensure_split(self):
+        if self._split is None:
+            part_index, num_parts = self._split_args
+            local = (
+                _plain_local_path(self.uspec.uri) if num_parts == 1 else None
+            )
+            self._split = (
+                _MmapChunks(local)
+                if local is not None
+                else io_split.create(
+                    self.uspec.uri, part_index, num_parts, type="text"
+                )
+            )
+        return self._split
+
     def __iter__(self) -> Iterator[Batch]:
+        split = self._ensure_split()
         B = self.spec.batch_size
-        base: Optional[int] = (
-            None if self._indexing_mode < 0
-            else (1 if self._indexing_mode > 0 else 0)
-        )
         x, labels, weights, packed = self._ring[self._slot]
         fill = 0
         first = True
         while True:
-            chunk = self._split.next_chunk()
+            chunk = split.next_chunk()
             if chunk is None:
                 break
             off = 0
             if first:
-                if bytes(memoryview(chunk)[:3]) == _BOM:
-                    off = 3  # UTF-8 BOM skip (text_parser.h:81-95)
-                if base is None:
-                    base = _probe_base(chunk)
+                off = self._first_chunk(chunk, off)
                 first = False
             n = len(chunk)
             cr_hint = -1  # probe once per chunk, cache across resumed calls
             while off < n:
-                rows, consumed, trunc, cr_hint = native.parse_libsvm_dense(
-                    chunk, off, base or 0, x, labels, weights, fill, cr_hint
+                rows, consumed, cr_hint = self._parse(
+                    chunk, off, x, labels, weights, fill, cr_hint
                 )
                 if consumed == 0 and rows == 0:
                     break  # defensive: no forward progress
                 off += consumed
                 fill += rows
                 self.rows_in += rows
-                self.truncated_nnz += trunc
                 if fill == B:
                     yield self._emit(x, labels, weights, packed, B)
                     self._slot = (self._slot + 1) % len(self._ring)
@@ -284,7 +293,103 @@ class FusedDenseLibSVMBatches:
             self._slot = (self._slot + 1) % len(self._ring)
 
     def close(self) -> None:
-        self._split.close()
+        if self._split is not None:
+            self._split.close()
+
+
+class FusedDenseLibSVMBatches(_FusedDenseTextBatches):
+    """libsvm text → dense [B,D] via dmlc_parse_libsvm_dense."""
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        indexing_mode: int = 0,
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_DENSE, "native fused kernel not loaded")
+        super().__init__(uri, spec, part_index, num_parts, ring)
+        if "indexing_mode" in self.uspec.args:
+            # per-dataset options ride the URI (reference uri_spec.h), same
+            # as the generic LibSVMParser path
+            indexing_mode = int(self.uspec.args["indexing_mode"])
+        if indexing_mode < 0 and num_parts > 1:
+            # auto mode must resolve identically on every shard: probe the
+            # head of the file, not this shard's mid-file first chunk
+            indexing_mode = _probe_base_from_uri(self.uspec.uri)
+        self._indexing_mode = indexing_mode
+        self._base: Optional[int] = (
+            None if indexing_mode < 0 else (1 if indexing_mode > 0 else 0)
+        )
+
+    def _first_chunk(self, chunk, off: int) -> int:
+        off = super()._first_chunk(chunk, off)
+        if self._base is None:
+            self._base = _probe_base(chunk)
+        return off
+
+    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+        rows, consumed, trunc, cr_hint = native.parse_libsvm_dense(
+            chunk, off, self._base or 0, x, labels, weights, fill, cr_hint
+        )
+        self.truncated_nnz += trunc
+        return rows, consumed, cr_hint
+
+
+class FusedDenseCSVBatches(_FusedDenseTextBatches):
+    """csv text → dense [B,D] via dmlc_parse_csv_dense.
+
+    Semantics match CSVParser + FixedShapeBatcher('dense') composed
+    (reference csv_parser.h:98-111): per-cell longest-prefix float parse;
+    ``label_column`` (default -1 = none, label 0.0, matching
+    CSVParserParam), ``weight_column`` and ``delimiter`` ride the URI
+    query or the constructor; a non-empty line with no delimiter raises,
+    as the generic parser does on a malformed file.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        label_column: int = -1,
+        weight_column: int = -1,
+        delimiter: str = ",",
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_CSV_DENSE, "native fused csv kernel not loaded")
+        super().__init__(uri, spec, part_index, num_parts, ring)
+        args = self.uspec.args
+        self._label_col = int(args.get("label_column", label_column))
+        self._weight_col = int(args.get("weight_column", weight_column))
+        # same validations as CSVParserParam/CSVParser, so fused and
+        # generic paths accept/reject identical URIs
+        check(
+            self._label_col != self._weight_col or self._label_col < 0,
+            "Must have distinct columns for labels and instance weights",
+        )
+        delim = str(args.get("delimiter", delimiter))
+        check(len(delim) == 1, f"delimiter must be one char, got {delim!r}")
+        check(ord(delim) < 128,
+              f"fused csv path requires an ASCII delimiter, got {delim!r}")
+        self._delim = ord(delim)
+        self.bad_lines = 0
+
+    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+        rows, consumed, trunc, cr_hint, bad = native.parse_csv_dense(
+            chunk, off, self._delim, self._label_col, self._weight_col,
+            x, labels, weights, fill, cr_hint,
+        )
+        self.truncated_nnz += trunc
+        if bad:
+            raise Error(
+                "Delimiter not found in the line. "
+                "Expected it to separate fields."
+            )
+        return rows, consumed, cr_hint
 
 
 class FusedEllRowRecBatches:
@@ -564,31 +669,49 @@ def dense_batches(
     nthread: Optional[int] = None,
     indexing_mode: int = 0,
     ring: int = 8,
+    format: str = "auto",
 ):
-    """Best-available dense Batch stream for a libsvm URI.
+    """Best-available dense Batch stream for a libsvm or csv URI.
 
-    Uses the fused native kernel when loaded, otherwise the generic
-    parser → FixedShapeBatcher path with the same semantics (including
-    ``indexing_mode``, whether passed here or as ``?indexing_mode=`` on
-    the URI). Either way the result is iterable and has ``.close()``.
+    ``format``: 'libsvm' | 'csv' | 'auto' (``?format=`` from the URI,
+    defaulting to libsvm — same resolution as the parser factory,
+    reference data.cc:68-76). Uses the fused native kernel when loaded,
+    otherwise the generic parser → FixedShapeBatcher path with the same
+    semantics (including ``indexing_mode``, whether passed here or as
+    ``?indexing_mode=`` on the URI). Either way the result is iterable
+    and has ``.close()``.
     """
-    if native.HAS_DENSE and spec.layout == "dense" and spec.value_dtype in (
+    uspec = URISpec(uri, part_index, num_parts)
+    if format == "auto":
+        format = str(uspec.args.get("format", "libsvm"))
+    check(format in ("libsvm", "csv"),
+          f"dense_batches supports libsvm/csv, not {format!r}")
+    fusable = spec.layout == "dense" and spec.value_dtype in (
         np.dtype(np.float32), np.dtype(np.float16)
-    ):
+    )
+    csv_delim = str(uspec.args.get("delimiter", ","))
+    if (format == "csv" and native.HAS_CSV_DENSE and fusable
+            and len(csv_delim) == 1 and ord(csv_delim) < 128):
+        # non-ASCII delimiters fall through to the generic parser (the
+        # native kernel scans single bytes)
+        return FusedDenseCSVBatches(
+            uri, spec, part_index, num_parts, ring=ring
+        )
+    if format == "libsvm" and native.HAS_DENSE and fusable:
         return FusedDenseLibSVMBatches(
             uri, spec, part_index, num_parts, indexing_mode, ring
         )
     from ..data import create_parser
     from .batcher import FixedShapeBatcher
 
-    uspec = URISpec(uri, part_index, num_parts)
-    if "indexing_mode" not in uspec.args and indexing_mode != 0:
+    if (format == "libsvm" and "indexing_mode" not in uspec.args
+            and indexing_mode != 0):
         sep = "?" if "?" not in uri.split("#", 1)[0] else "&"
         head, _, frag = uri.partition("#")
         uri = f"{head}{sep}indexing_mode={indexing_mode}" + (
             f"#{frag}" if frag else ""
         )
     parser = create_parser(
-        uri, part_index, num_parts, type="libsvm", nthread=nthread
+        uri, part_index, num_parts, type=format, nthread=nthread
     )
     return _GenericBatchStream(parser, FixedShapeBatcher(spec))

@@ -78,7 +78,14 @@ def _packed_layout(batch: Batch):
 def _unpacker(layout, platform: str):
     """Jitted u8[n] → dict-of-arrays bitcast unpack (runs in HBM; slicing
     and bitcasting on device are bandwidth-trivial next to the transfer
-    they replace)."""
+    they replace).
+
+    The u8 input is NOT donated: XLA donates buffer-to-buffer, and no
+    single unpack output can alias the whole packed buffer (the outputs
+    are several smaller arrays), so donation can never be honored — it
+    only emits per-layout warnings. The packed buffer's lifetime ends
+    when the unpack completes; XLA frees it then.
+    """
     key = (layout, platform)
     fn = _UNPACKERS.get(key)
     if fn is not None:
@@ -97,12 +104,7 @@ def _unpacker(layout, platform: str):
             ).reshape(shape)
         return out
 
-    # donate the u8 input: it is never reused after the call, and without
-    # donation the packed bytes AND the unpacked arrays stay live in HBM
-    # for every in-flight batch (the CPU backend can't donate — it warns
-    # and ignores, so don't ask there)
-    donate = (0,) if platform != "cpu" else ()
-    fn = jax.jit(unpack, donate_argnums=donate)
+    fn = jax.jit(unpack)
     _UNPACKERS[key] = fn
     return fn
 

@@ -727,8 +727,6 @@ inline bool parse_dense_line(const char* lb, const char* le, DenseState& st,
   return true;
 }
 
-}  // namespace
-
 // Out-params mirror _DenseResult in dmlc_core_tpu/data/native.py.
 struct DenseResult {
   int64_t rows_written;
@@ -736,6 +734,50 @@ struct DenseResult {
   int64_t truncated;
   int64_t has_cr;  // echo of the '\r' probe so callers can cache it
 };
+
+// Resumable line walk shared by the fused text->dense kernels: calls
+// fn(line_begin, line_end, row) per line (Python splitlines semantics:
+// '\n', '\r', "\r\n"), stopping at buffer-full or chunk-end. Returns the
+// cached/probed has_cr and fills rows_written/bytes_consumed.
+template <typename LineFn>
+bool walk_dense_lines(const char* buf, int64_t len, int64_t row_start,
+                      int64_t row_capacity, int32_t cr_hint,
+                      DenseResult* out, LineFn&& fn) {
+  const char* p = buf;
+  const char* end = buf + len;
+  int64_t row = row_start;
+  // one SIMD scan (per chunk, cached by the caller via the hint) decides
+  // whether per-line '\r' handling is needed at all
+  const bool has_cr =
+      cr_hint < 0 ? memchr(buf, '\r', static_cast<size_t>(len)) != nullptr
+                  : cr_hint != 0;
+  while (p < end && row < row_capacity) {
+    // memchr keeps the scan SIMD-fast on the common '\n'-only data
+    const char* nl =
+        static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
+    const char* seg_end = nl ? nl : end;
+    const char* cr =
+        has_cr ? static_cast<const char*>(
+                     memchr(p, '\r', static_cast<size_t>(seg_end - p)))
+               : nullptr;
+    const char* line_end;
+    const char* next;
+    if (cr) {
+      line_end = cr;
+      next = (cr + 1 == nl) ? nl + 1 : cr + 1;
+    } else {
+      line_end = seg_end;
+      next = nl ? nl + 1 : end;
+    }
+    if (fn(p, line_end, row)) ++row;
+    p = next;
+  }
+  out->rows_written = row - row_start;
+  out->bytes_consumed = p - buf;
+  return has_cr;
+}
+
+}  // namespace
 
 // cr_hint: -1 = unknown (probe the remaining buffer once — callers cache
 // the echoed result across resumed calls on the same chunk), 0 = no '\r'
@@ -754,40 +796,89 @@ DMLC_API void dmlc_parse_libsvm_dense(
                 out_f16 != 0,
                 static_cast<int64_t>(base),
                 0};
-  const char* p = buf;
-  const char* end = buf + len;
-  int64_t row = row_start;
-  // one SIMD scan (per chunk, cached by the caller via the hint) decides
-  // whether per-line '\r' handling is needed at all
-  const bool has_cr =
-      cr_hint < 0 ? memchr(buf, '\r', static_cast<size_t>(len)) != nullptr
-                  : cr_hint != 0;
-  while (p < end && row < row_capacity) {
-    // line ends at '\n', '\r', or "\r\n" (Python splitlines semantics);
-    // memchr keeps the scan SIMD-fast on the common '\n'-only data
-    const char* nl =
-        static_cast<const char*>(memchr(p, '\n', static_cast<size_t>(end - p)));
-    const char* seg_end = nl ? nl : end;
-    const char* cr =
-        has_cr ? static_cast<const char*>(
-                     memchr(p, '\r', static_cast<size_t>(seg_end - p)))
-               : nullptr;
-    const char* line_end;
-    const char* next;
-    if (cr) {
-      line_end = cr;
-      next = (cr + 1 == nl) ? nl + 1 : cr + 1;
-    } else {
-      line_end = seg_end;
-      next = nl ? nl + 1 : end;
-    }
-    if (parse_dense_line(p, line_end, st, row)) ++row;
-    p = next;
-  }
-  out->rows_written = row - row_start;
-  out->bytes_consumed = p - buf;
+  const bool has_cr = walk_dense_lines(
+      buf, len, row_start, row_capacity, cr_hint, out,
+      [&](const char* lb, const char* le, int64_t row) {
+        return parse_dense_line(lb, le, st, row);
+      });
   out->truncated = st.truncated;
   out->has_cr = has_cr ? 1 : 0;
+}
+
+// -- csv -> fixed-shape dense batch -------------------------------------------
+//
+// Same resumable chunk contract as dmlc_parse_libsvm_dense; semantics match
+// CSVParser + FixedShapeBatcher('dense') composed (reference
+// src/data/csv_parser.h:98-111): longest-prefix float parsing per cell
+// (strtof semantics, 0.0 on junk), label/weight columns lifted out, the
+// k-th remaining column scatters to feature k (truncated + counted when
+// k >= D). A non-empty line with no delimiter is a malformed-file error
+// (counted in bad_lines; the Python wrapper raises, like the generic
+// parser's "Delimiter not found" error).
+
+struct CsvDenseResult {
+  int64_t rows_written;
+  int64_t bytes_consumed;
+  int64_t truncated;
+  int64_t has_cr;
+  int64_t bad_lines;
+};
+
+DMLC_API void dmlc_parse_csv_dense(
+    const char* buf, int64_t len, int32_t delimiter, int32_t label_column,
+    int32_t weight_column, int64_t num_features, int32_t out_f16, void* x,
+    float* labels, float* weights, int64_t row_start, int64_t row_capacity,
+    int32_t cr_hint, CsvDenseResult* out) {
+  std::vector<float> scratch(static_cast<size_t>(num_features));
+  DenseState st{x, labels, weights, scratch.data(), num_features,
+                out_f16 != 0, 0, 0};
+  const char delim = static_cast<char>(delimiter);
+  int64_t bad = 0;
+  DenseResult inner{};
+  const bool has_cr = walk_dense_lines(
+      buf, len, row_start, row_capacity, cr_hint, &inner,
+      [&](const char* lb, const char* le, int64_t row) {
+        if (lb == le) return false;  // empty line: skipped, no row
+        std::memset(st.scratch, 0, static_cast<size_t>(st.D) * 4);
+        const char* p = lb;
+        int col = 0;
+        int64_t k = 0;
+        float lab = 0.0f, w = 1.0f;
+        while (p <= le) {
+          const char* ce = static_cast<const char*>(
+              memchr(p, delim, static_cast<size_t>(le - p)));
+          if (!ce) ce = le;
+          const double v = parse_float_prefix(p, ce);
+          if (col == label_column) {
+            lab = static_cast<float>(v);
+          } else if (col == weight_column) {
+            w = static_cast<float>(v);
+          } else {
+            if (k < st.D) {
+              st.scratch[k] = static_cast<float>(v);
+            } else {
+              ++st.truncated;
+            }
+            ++k;
+          }
+          ++col;
+          if (ce == le) break;
+          p = ce + 1;
+        }
+        if (k == 0) {
+          ++bad;
+          return false;
+        }
+        st.labels[row] = lab;
+        st.weights[row] = w;
+        row_flush(st, row);
+        return true;
+      });
+  out->rows_written = inner.rows_written;
+  out->bytes_consumed = inner.bytes_consumed;
+  out->truncated = st.truncated;
+  out->has_cr = has_cr ? 1 : 0;
+  out->bad_lines = bad;
 }
 
 // -- RecordIO frame scan + fused rowrec -> ELL batch --------------------------
