@@ -22,6 +22,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns subprocesses with fresh jax imports"
     )
+    config.addinivalue_line(
+        "markers",
+        "jax: imports jax in-process (excluded from sanitizer runs — the "
+        "ASan/TSan runtime trips on XLA internals, not on our native core)",
+    )
 
 # The axon TPU plugin in this image force-registers itself and wins over
 # JAX_PLATFORMS env alone; the config update below reliably pins the test

@@ -164,6 +164,7 @@ def test_overflow_error_policy(tmp_path):
     stream.close()
 
 
+@pytest.mark.jax
 def test_ring_reuse_through_staging_pipeline(tmp_path):
     """Staged device batches must not alias ring buffers: after the ring
     wraps many times, device contents still match a fresh parse."""
