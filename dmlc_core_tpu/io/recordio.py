@@ -16,8 +16,12 @@ the writer splits the record at each such occurrence into a multi-part chain
 
 TPU-first design departure: scanning for aligned magic words is the hot loop;
 we vectorize it with one numpy view + compare over the whole payload instead
-of a byte loop (reference scans per-word, src/recordio.cc:22-28). The native
-C++ core does the same with SIMD-friendly word scans.
+of a byte loop (reference scans per-word, src/recordio.cc:22-28). The hot
+READ path has a native counterpart: native/fastparse.cc
+``dmlc_parse_rowrec_ell`` walks frames (magic/lrec headers, multipart
+chains) directly in C++ on the RecordIO→HBM staging path
+(staging/fused.py); this Python codec remains the writer and the
+reference implementation the native kernel's parity tests check against.
 """
 
 from __future__ import annotations

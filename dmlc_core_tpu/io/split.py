@@ -68,7 +68,15 @@ class InputSplit:
         raise NotImplementedError
 
     def next_batch(self, n_records: int) -> Optional[bytes]:
-        """Chunk with a record-count hint (reference io.h:230-247)."""
+        """Chunk with a record-count hint.
+
+        The default IGNORES the hint by design — exact parity with the
+        reference, whose base InputSplit::NextBatch is ``return
+        NextChunk(out_chunk)`` (io.h:230-232) and whose InputSplitBase::
+        NextBatchEx forwards to NextChunkEx (input_split_base.h:115-117).
+        Only IndexedRecordIOSplitter honors n_records (there as here:
+        next_batch_ex below), because only count-indexed splits can seek
+        per record."""
         return self.next_chunk()
 
     def before_first(self) -> None:

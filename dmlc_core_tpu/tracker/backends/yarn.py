@@ -6,6 +6,15 @@ client invocation (env contract included — DMLC_MAX_ATTEMPT drives AM
 relaunch); executing it requires a Hadoop installation, so without
 $HADOOP_HOME the backend fails with a clear message (dry-run always
 works).
+
+The AM's *capability* — per-task relaunch budgets, host blacklisting,
+abort past the limit (ApplicationMaster.java:537-569) — lives in
+``tracker/supervisor.py`` and supervises the clusters this framework
+owns end-to-end (local, tpu-pod; kubernetes delegates to the Job
+controller via the same DMLC_MAX_ATTEMPT contract). The Hadoop-specific
+Java AM binary is deliberately not reimplemented: a TPU deployment has
+no JVM/Hadoop, and a user running under a real YARN cluster brings the
+stock AM, driven by the env this backend exports.
 """
 
 from __future__ import annotations
