@@ -10,6 +10,7 @@ from .fused import (
     FusedDenseCSVBatches,
     FusedDenseLibSVMBatches,
     FusedEllRowRecBatches,
+    ShardedFusedBatches,
     dense_batches,
     ell_batches,
 )
@@ -22,6 +23,7 @@ __all__ = [
     "FusedDenseCSVBatches",
     "FusedDenseLibSVMBatches",
     "FusedEllRowRecBatches",
+    "ShardedFusedBatches",
     "StagingPipeline",
     "dense_batches",
     "ell_batches",

@@ -25,6 +25,7 @@ from typing import Any, Dict, Iterable, Iterator, Optional
 import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
+from ..utils.profiler import annotate
 from ..utils.timer import get_time
 from .batcher import Batch
 
@@ -212,12 +213,14 @@ class StagingPipeline:
         inflight: deque = deque()
         while True:
             while len(inflight) < self._depth:
-                host = self._host_iter.next()
+                with annotate("dmlc:host_pull"):
+                    host = self._host_iter.next()
                 if host is None:
                     break
-                dev = stage_batch(
-                    host, self._device, self._mesh, self._data_axis
-                )
+                with annotate("dmlc:stage"):
+                    dev = stage_batch(
+                        host, self._device, self._mesh, self._data_axis
+                    )
                 self.rows_staged += host.n_valid
                 self.batches_staged += 1
                 self.bytes_staged += sum(
@@ -234,7 +237,8 @@ class StagingPipeline:
             # ring of host buffers (staging/fused.py) can size the ring as
             # prefetch + depth + consumer instead of "unbounded, because
             # async dispatch may read the host buffer arbitrarily late".
-            self._jax.block_until_ready(dev)
+            with annotate("dmlc:transfer_wait"):
+                self._jax.block_until_ready(dev)
             yield dev
 
     def throughput(self) -> Dict[str, float]:

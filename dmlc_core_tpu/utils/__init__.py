@@ -20,3 +20,4 @@ from .logging import (  # noqa: F401
 from .timer import get_time, Timer  # noqa: F401
 from .env import get_env, set_env  # noqa: F401
 from .common import split_string, hash_combine, ThreadException  # noqa: F401
+from .profiler import annotate, trace  # noqa: F401

@@ -1,0 +1,67 @@
+"""Tracing/profiling hooks: host-side spans + XProf trace capture.
+
+SURVEY §5.1: the reference's observability is wall-clock logging
+(timer.h, MB/sec lines); its rebuild note asks for host-side timing plus
+optional XLA/XProf trace hooks around infeed. This module provides both
+without making jax a hard dependency of the data layer:
+
+- ``annotate(name)``: a ``jax.profiler.TraceAnnotation`` when jax is
+  importable (spans show up on the XProf host timeline inside any active
+  trace), else a no-op context manager. Cheap enough to leave on: when
+  no trace is active the annotation is a couple of TraceMe calls.
+- ``trace(logdir)``: context manager around
+  ``jax.profiler.start_trace/stop_trace`` — wrap any region (e.g. a
+  bench epoch) and open the logdir with XProf/TensorBoard.
+
+StagingPipeline wires ``annotate`` around its pull/stage/wait phases, so
+a trace of a training loop shows exactly where infeed time goes
+(host parse vs DMA vs consumer).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager, nullcontext
+
+__all__ = ["annotate", "trace"]
+
+
+_PROF = False  # unresolved sentinel; None = jax absent
+
+
+def _jax_profiler():
+    global _PROF
+    if _PROF is False:  # resolve once — annotate() sits on the hot loop
+        try:
+            import jax.profiler as prof  # deferred: works without jax
+
+            _PROF = prof
+        except ImportError:
+            _PROF = None
+    return _PROF
+
+
+def annotate(name: str):
+    """Context manager marking a host-side span on the XProf timeline
+    (no-op without jax)."""
+    prof = _jax_profiler()
+    if prof is None:
+        return nullcontext()
+    return prof.TraceAnnotation(name)
+
+
+@contextmanager
+def trace(logdir: str):
+    """Capture an XProf trace of the enclosed region into ``logdir``.
+
+    Requires jax. View with ``tensorboard --logdir <logdir>`` (or the
+    xprof CLI); host annotations from ``annotate`` appear on the host
+    threads, device ops on the device timeline.
+    """
+    prof = _jax_profiler()
+    if prof is None:
+        raise RuntimeError("profiler trace requires jax")
+    prof.start_trace(logdir)
+    try:
+        yield
+    finally:
+        prof.stop_trace()
