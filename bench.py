@@ -41,13 +41,17 @@ EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
 # parse fan-out: >1 engages ShardedFusedBatches (threads; native kernels
 # release the GIL). Defaults to the core count on multi-core TPU hosts,
-# capped so every sub-shard still covers several full batches — otherwise
-# a many-core host over-shards the fixed-size data into padded tails and
-# the bench measures padding, not throughput.
-_nt = int(os.environ.get("BENCH_NTHREAD", "0")) or min(
-    os.cpu_count() or 1, max(1, N_ROWS // (BATCH * 4))
-)
-NTHREAD = _nt if _nt > 1 else None
+# capped PER STREAM so every sub-shard still covers several full batches
+# — otherwise a many-core host over-shards the fixed-size data into
+# padded tails and the bench measures padding, not throughput.
+_nt_env = int(os.environ.get("BENCH_NTHREAD", "0"))
+
+
+def _nthread_for(rows: int):
+    nt = _nt_env or min(os.cpu_count() or 1, max(1, rows // (BATCH * 4)))
+    return nt if nt > 1 else None
+
+
 DATA = os.environ.get(
     "BENCH_DATA", f"/tmp/dmlc_tpu_bench_higgs_{N_ROWS}.libsvm"
 )
@@ -207,7 +211,11 @@ def _make_higgs_stream(value_dtype: str):
         num_features=N_FEATURES + 1,
         value_dtype=np.dtype(value_dtype),
     )
-    return dense_batches(DATA, spec, nthread=NTHREAD), "x", DATA
+    return (
+        dense_batches(DATA, spec, nthread=_nthread_for(N_ROWS)),
+        "x",
+        DATA,
+    )
 
 
 CSV_DATA = os.environ.get(
@@ -250,7 +258,8 @@ def _make_csv_stream(value_dtype: str):
     )
     return (
         dense_batches(
-            CSV_DATA + "?format=csv&label_column=0", spec, nthread=NTHREAD
+            CSV_DATA + "?format=csv&label_column=0", spec,
+            nthread=_nthread_for(N_ROWS),
         ),
         "x",
         CSV_DATA,
@@ -266,7 +275,11 @@ def _make_rec_stream(value_dtype: str):
         max_nnz=REC_K,
         value_dtype=np.dtype(value_dtype),
     )
-    return ell_batches(REC_DATA, spec, nthread=NTHREAD), "values", REC_DATA
+    return (
+        ell_batches(REC_DATA, spec, nthread=_nthread_for(REC_ROWS)),
+        "values",
+        REC_DATA,
+    )
 
 
 def run_epoch(make_stream, value_dtype: str) -> dict:
@@ -343,7 +356,7 @@ def main() -> None:
                 "fused_ell_kernel": native.HAS_ELL,
                 "fused_csv_kernel": native.HAS_CSV_DENSE,
                 "host_cpus": os.cpu_count(),
-                "parse_threads": NTHREAD or 1,
+                "parse_threads": _nthread_for(N_ROWS) or 1,
             }
         )
     )

@@ -128,13 +128,19 @@ class _MmapChunks:
         self._f.close()
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=64)
 def _probe_base_from_uri(uri: str) -> int:
     """Resolve libsvm auto indexing from the head of the FIRST file.
 
     Probing at offset 0 (not at this shard's own first chunk) keeps the
     resolved base identical across all (part_index, num_parts) shards —
     different shards must never disagree and silently shift feature
-    columns against each other.
+    columns against each other. Cached per URI: a threaded fan-out
+    constructs one producer per sub-shard and must not re-read (possibly
+    remote) file heads per thread.
     """
     fs = FileSystem.get_instance(uri.split(";")[0])
     first = io_split._expand_uris(fs, uri)[0]
@@ -671,6 +677,16 @@ class ShardedFusedBatches:
     @property
     def rows_out(self) -> int:
         return sum(p.rows_out for p in self._producers)
+
+    @property
+    def bad_records(self) -> int:
+        """Aggregated corrupt-record count (ELL sub-producers)."""
+        return sum(getattr(p, "bad_records", 0) for p in self._producers)
+
+    @property
+    def bad_lines(self) -> int:
+        """Aggregated malformed-line count (CSV sub-producers)."""
+        return sum(getattr(p, "bad_lines", 0) for p in self._producers)
 
     def __iter__(self) -> Iterator[Batch]:
         active = list(self._iters)

@@ -1,0 +1,98 @@
+"""End-to-end Criteo-style path: rowrec RecordIO → fused ELL staging →
+jitted factorization machine, with checkpoint resume.
+
+This is the RecordIO north-star pipeline (BASELINE.md): rows are stored
+pre-parsed in reference-bit-compatible RecordIO frames (data/rowrec.py),
+the fused native kernel scans frames straight into packed ELL batch
+rings, and each batch rides one DMA into HBM.
+
+Single host:   python examples/train_criteo_rec.py [/path/to/data.rec]
+Multi-process: ./dmlc-submit --cluster local --num-workers 2 \
+                   python examples/train_criteo_rec.py /path/to/data.rec
+
+Generates a small synthetic shard when no path is given.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+N_FEATURES = 1 << 14
+K = 39  # 13 dense + 26 categorical, Criteo-shaped
+
+
+def synth(path: str, rows: int = 20000) -> None:
+    from dmlc_core_tpu.data.row_block import RowBlock
+    from dmlc_core_tpu.data.rowrec import write_rowrec
+    from dmlc_core_tpu.io.stream import FileStream
+
+    rng = np.random.default_rng(0)
+    idx = np.empty((rows, K), dtype=np.uint32)
+    idx[:, :13] = np.arange(13)
+    idx[:, 13:] = rng.integers(13, N_FEATURES, (rows, 26))
+    val = np.ones((rows, K), dtype=np.float32)
+    val[:, :13] = rng.uniform(0, 1, (rows, 13))
+    w = rng.normal(size=N_FEATURES) / np.sqrt(K)
+    logits = (w[idx] * val).sum(axis=1)
+    labels = (logits > 0).astype(np.float32)
+    blk = RowBlock(
+        offset=np.arange(rows + 1, dtype=np.int64) * K,
+        label=labels,
+        index=idx.reshape(-1),
+        value=val.reshape(-1),
+    )
+    with FileStream(path, "w") as f:
+        write_rowrec(f, [blk])
+
+
+def main() -> None:
+    import jax
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.models import FactorizationMachine
+    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, ell_batches
+
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/criteo_demo.rec"
+    if not os.path.exists(path):
+        print(f"generating synthetic rowrec shard at {path}")
+        synth(path)
+
+    # shard by worker rank when launched through dmlc-submit
+    rank = int(os.environ.get("DMLC_TASK_ID", 0))
+    world = int(os.environ.get("DMLC_NUM_WORKER", 1))
+    model = FactorizationMachine(N_FEATURES, embed_dim=8)
+    params = model.init(jax.random.PRNGKey(0))
+    step = jax.jit(lambda p, b: model.sgd_step(p, b, lr=0.1))
+    ck = Checkpointer("/tmp/criteo_ckpts", keep=2, process_index=rank)
+
+    start = ck.latest_step()
+    if start is not None:
+        start, params = ck.restore(start)
+        print(f"rank {rank}: resumed from checkpoint step {start}")
+    first_epoch = 0 if start is None else start + 1
+
+    spec = BatchSpec(batch_size=2048, layout="ell", max_nnz=K)
+    for epoch in range(first_epoch, first_epoch + 3):
+        stream = ell_batches(path, spec, part_index=rank, num_parts=world)
+        pipe = StagingPipeline(stream)
+        loss = None
+        for batch in pipe:
+            params, loss = step(params, batch)
+        stats = pipe.throughput()
+        loss_str = "n/a (empty shard)" if loss is None else f"{float(loss):.4f}"
+        print(
+            f"rank {rank} epoch {epoch}: loss={loss_str} "
+            f"({stats['rows_per_sec']:,.0f} rows/s, "
+            f"{stats['mb_per_sec']:,.0f} MB/s into device)"
+        )
+        stream.close()
+        pipe.close()
+        ck.save(epoch, params)
+    print("latest checkpoint step:", ck.latest_step())
+
+
+if __name__ == "__main__":
+    main()
