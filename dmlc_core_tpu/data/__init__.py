@@ -75,8 +75,14 @@ def _create_libfm(uri, args, part_index, num_parts, nthread=None, index_dtype=IN
 
 @PARSER_REGISTRY.register("rowrec")
 def _create_rowrec(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
+    # epoch shuffling rides the URI (reference-style sugar):
+    # ?shuffle_parts=N&seed=S → InputSplitShuffle macro-shuffle
     return RowRecParser(
-        io_split.create(uri, part_index, num_parts, type="recordio"),
+        io_split.create(
+            uri, part_index, num_parts, type="recordio",
+            num_shuffle_parts=int(args.get("shuffle_parts", 0)),
+            seed=int(args.get("seed", 0)),
+        ),
         args,
         nthread,
         index_dtype,
@@ -135,7 +141,14 @@ def create_row_block_iter(
         )
 
     if spec.cache_file:
-        # factory form: a warm cache never touches the raw data source
+        # a warm cache never touches the raw data source — which is also
+        # why epoch shuffling cannot ride it: the first epoch's order
+        # would be frozen into the cache (same guard as io_split.create)
+        if int(spec.args.get("shuffle_parts", 0)):
+            raise Error(
+                "shuffle_parts with a #cachefile would freeze the first "
+                "epoch's shuffle order into the cache; pick one"
+            )
         return DiskRowIter(make_parser, spec.cache_file, reuse_cache=True)
     return BasicRowIter(make_parser())
 

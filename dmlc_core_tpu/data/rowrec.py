@@ -178,9 +178,9 @@ class RowRecParser(Parser):
     ) -> None:
         if source is None:
             check(uri is not None, "RowRecParser needs a source or a uri")
-            source = io_split.create(
-                uri, part_index, num_parts, type="recordio"
-            )
+            # URI sugar (?shuffle_parts=N&seed=S etc.) is honored inside
+            # io_split.create, so a full URI is all that's needed here
+            source = io_split.create(uri, part_index, num_parts, type="recordio")
         self._source = source
         self._bytes = 0
         self._index_dtype = index_dtype

@@ -939,6 +939,13 @@ def create(
     - ``type``: 'text' | 'recordio' | 'indexed_recordio'
     """
     spec = URISpec(uri, part_index, num_parts)
+    # epoch-shuffle sugar rides the URI for every record type
+    # (?shuffle_parts=N&seed=S — reference-style per-dataset options);
+    # explicit keyword args win when both are given
+    if num_shuffle_parts == 0:
+        num_shuffle_parts = int(spec.args.get("shuffle_parts", 0))
+        if num_shuffle_parts and seed == 0:
+            seed = int(spec.args.get("seed", 0))
     if type == "text" and spec.uri == "-":
         return SingleFileSplit("-")
     if type == "text":
@@ -969,7 +976,12 @@ def create(
             "num_shuffle_parts with a #cachefile would freeze the first "
             "epoch's shuffle order into the cache; pick one",
         )
-        return InputSplitShuffle(base, part_index, num_parts, num_shuffle_parts, seed)
+        shuffled = InputSplitShuffle(
+            base, part_index, num_parts, num_shuffle_parts, seed
+        )
+        # shuffling must not cost the read-ahead thread the unshuffled
+        # path gets
+        return ThreadedInputSplit(shuffled) if threaded else shuffled
     if spec.cache_file:
         # cached OR threaded, never both: CachedInputSplit prefetches
         # internally (reference io.cc:119-124 chooses exactly one wrapper)

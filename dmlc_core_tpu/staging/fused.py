@@ -431,13 +431,24 @@ class FusedEllRowRecBatches:
               "fused ELL path stages int32 indices")
         self.spec = spec
         uspec = URISpec(uri, part_index, num_parts)
-        local = _plain_local_path(uspec.uri) if num_parts == 1 else None
+        # epoch shuffling rides the URI (?shuffle_parts=N&seed=S →
+        # InputSplitShuffle); it reorders sub-parts, so the sequential
+        # mmap fast path is only taken without it
+        shuffle_parts = int(uspec.args.get("shuffle_parts", 0))
+        seed = int(uspec.args.get("seed", 0))
+        local = (
+            _plain_local_path(uspec.uri)
+            if num_parts == 1 and shuffle_parts == 0
+            else None
+        )
         self._mmap = local is not None
         self._split = (
             _MmapRawChunks(local)
             if local is not None
-            else io_split.create(uspec.uri, part_index, num_parts,
-                                 type="recordio")
+            else io_split.create(
+                uspec.uri, part_index, num_parts, type="recordio",
+                num_shuffle_parts=shuffle_parts, seed=seed,
+            )
         )
         B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
         # one contiguous buffer per slot → one DMA per staged batch
