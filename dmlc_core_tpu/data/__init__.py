@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..io import split as io_split
-from ..io.uri import URISpec
+from ..io.uri import URISpec, rejoin_query, uri_int
 from ..utils.logging import Error
 from .csv_parser import CSVParser, CSVParserParam
 from .libfm_parser import LibFMParser, LibFMParserParam
@@ -75,13 +75,12 @@ def _create_libfm(uri, args, part_index, num_parts, nthread=None, index_dtype=IN
 
 @PARSER_REGISTRY.register("rowrec")
 def _create_rowrec(uri, args, part_index, num_parts, nthread=None, index_dtype=INDEX_T):
-    # epoch shuffling rides the URI (reference-style sugar):
-    # ?shuffle_parts=N&seed=S → InputSplitShuffle macro-shuffle
+    # re-attach the query args so io_split.create resolves ALL the URI
+    # sugar itself (?shuffle_parts=N&seed=S macro-shuffle,
+    # ?index=<uri>&shuffle=1 count-indexed reads) — one resolver, no drift
     return RowRecParser(
         io_split.create(
-            uri, part_index, num_parts, type="recordio",
-            num_shuffle_parts=int(args.get("shuffle_parts", 0)),
-            seed=int(args.get("seed", 0)),
+            uri + rejoin_query(args), part_index, num_parts, type="recordio"
         ),
         args,
         nthread,
@@ -132,7 +131,7 @@ def create_row_block_iter(
 
     def make_parser() -> Parser:
         return create_parser(
-            spec.uri + _requery(spec),
+            spec.uri + rejoin_query(spec.args),
             part_index,
             num_parts,
             type,
@@ -144,16 +143,12 @@ def create_row_block_iter(
         # a warm cache never touches the raw data source — which is also
         # why epoch shuffling cannot ride it: the first epoch's order
         # would be frozen into the cache (same guard as io_split.create)
-        if int(spec.args.get("shuffle_parts", 0)):
+        if uri_int(spec.args, "shuffle_parts", 0) or (
+            "index" in spec.args and uri_int(spec.args, "shuffle", 0)
+        ):
             raise Error(
-                "shuffle_parts with a #cachefile would freeze the first "
+                "epoch shuffling with a #cachefile would freeze the first "
                 "epoch's shuffle order into the cache; pick one"
             )
         return DiskRowIter(make_parser, spec.cache_file, reuse_cache=True)
     return BasicRowIter(make_parser())
-
-
-def _requery(spec: URISpec) -> str:
-    if not spec.args:
-        return ""
-    return "?" + "&".join(f"{k}={v}" for k, v in spec.args.items())

@@ -39,7 +39,11 @@ from typing import Iterable, List, Optional
 import numpy as np
 
 from ..io import split as io_split
-from ..io.recordio import RecordIOChunkReader, RecordIOWriter
+from ..io.recordio import (
+    IndexedRecordIOWriter,
+    RecordIOChunkReader,
+    RecordIOWriter,
+)
 from ..io.stream import Stream
 from ..utils.logging import Error, check
 from .parser import Parser
@@ -145,9 +149,21 @@ def decode_records(records: Iterable) -> RowBlock:
     )
 
 
-def write_rowrec(stream: Stream, blocks: Iterable[RowBlock]) -> int:
-    """Write RowBlocks as rowrec RecordIO frames; returns rows written."""
-    writer = RecordIOWriter(stream)
+def write_rowrec(
+    stream: Stream,
+    blocks: Iterable[RowBlock],
+    index_stream: Optional[Stream] = None,
+) -> int:
+    """Write RowBlocks as rowrec RecordIO frames; returns rows written.
+
+    With ``index_stream``, also emits the ``key offset`` index that an
+    IndexedRecordIOSplitter shards by record count (enabling
+    ``uri?index=<index_uri>&shuffle=1`` reads)."""
+    writer = (
+        RecordIOWriter(stream)
+        if index_stream is None
+        else IndexedRecordIOWriter(stream, index_stream)
+    )
     n = 0
     for blk in blocks:
         for payload in encode_rows(blk):

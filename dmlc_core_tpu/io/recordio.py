@@ -37,6 +37,7 @@ from .stream import SeekStream, Stream
 __all__ = [
     "KMAGIC",
     "RecordIOWriter",
+    "IndexedRecordIOWriter",
     "RecordIOReader",
     "RecordIOChunkReader",
     "encode_lrec",
@@ -84,6 +85,7 @@ class RecordIOWriter:
     def __init__(self, stream: Stream) -> None:
         self.stream = stream
         self.except_counter = 0  # number of magic collisions escaped
+        self.bytes_written = 0  # framed bytes emitted through this writer
 
     def write_record(self, data: bytes) -> None:
         check_lt(len(data), _MAX_LEN, "RecordIO only accepts records < 2^29 bytes")
@@ -106,11 +108,38 @@ class RecordIOWriter:
         pad = (4 - (tail_len & 3)) & 3
         if pad:
             out.append(b"\x00" * pad)
-        self.stream.write(b"".join(out))
+        framed = b"".join(out)
+        self.stream.write(framed)
+        self.bytes_written += len(framed)
 
     def tell(self) -> int:
         check(isinstance(self.stream, SeekStream), "stream is not seekable")
         return self.stream.tell()  # type: ignore[union-attr]
+
+
+class IndexedRecordIOWriter(RecordIOWriter):
+    """RecordIO writer that also emits the external index file an
+    IndexedRecordIOSplitter shards by.
+
+    Index format: whitespace-separated ``key offset`` pairs, one record
+    per line (reference ReadIndexFile,
+    src/io/indexed_recordio_split.cc:43-62). Keys default to the record
+    ordinal. Offsets are the writer's own running byte count, so any
+    Stream works (pipes, remote sinks) — but they are only valid index
+    offsets when the writer starts at byte 0 of the destination file.
+    """
+
+    def __init__(self, stream: Stream, index_stream: Stream) -> None:
+        super().__init__(stream)
+        self.index_stream = index_stream
+        self._count = 0
+
+    def write_record(self, data: bytes, key: Optional[int] = None) -> None:
+        offset = self.bytes_written
+        super().write_record(data)
+        k = self._count if key is None else key
+        self.index_stream.write(f"{k}\t{offset}\n".encode())
+        self._count += 1
 
 
 class RecordIOReader:

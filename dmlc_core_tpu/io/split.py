@@ -35,7 +35,7 @@ from .recordio import (
     last_head_in_words,
 )
 from .stream import SeekStream, Stream
-from .uri import URISpec
+from .uri import URISpec, uri_int
 
 __all__ = [
     "InputSplit",
@@ -924,9 +924,9 @@ def create(
     num_parts: int = 1,
     type: str = "text",
     index_uri: Optional[str] = None,
-    shuffle: bool = False,
+    shuffle: Optional[bool] = None,
     seed: int = 0,
-    batch_size: int = 256,
+    batch_size: Optional[int] = None,
     recurse_directories: bool = False,
     num_shuffle_parts: int = 0,
     threaded: bool = True,
@@ -939,13 +939,30 @@ def create(
     - ``type``: 'text' | 'recordio' | 'indexed_recordio'
     """
     spec = URISpec(uri, part_index, num_parts)
-    # epoch-shuffle sugar rides the URI for every record type
-    # (?shuffle_parts=N&seed=S — reference-style per-dataset options);
-    # explicit keyword args win when both are given
+    # per-dataset options ride the URI (reference-style sugar); explicit
+    # keyword args win when both are given:
+    #   ?shuffle_parts=N&seed=S       macro-shuffle, any record type
+    #   ?index=<uri>[&shuffle=1][&batch_size=N]   count-indexed recordio
     if num_shuffle_parts == 0:
-        num_shuffle_parts = int(spec.args.get("shuffle_parts", 0))
-        if num_shuffle_parts and seed == 0:
-            seed = int(spec.args.get("seed", 0))
+        num_shuffle_parts = uri_int(spec.args, "shuffle_parts", 0)
+    if type == "recordio" and (index_uri is not None or "index" in spec.args):
+        if index_uri is None:
+            index_uri = str(spec.args["index"])
+        type = "indexed_recordio"
+    if seed == 0:
+        seed = uri_int(spec.args, "seed", 0)
+    if type == "indexed_recordio":
+        if shuffle is None:
+            shuffle = bool(uri_int(spec.args, "shuffle", 0))
+        if batch_size is None:
+            batch_size = uri_int(spec.args, "batch_size", 256)
+        check(
+            not (shuffle and spec.cache_file),
+            "indexed shuffle with a #cachefile would freeze the first "
+            "epoch's shuffle order into the cache; pick one",
+        )
+    shuffle = bool(shuffle)
+    batch_size = 256 if batch_size is None else batch_size
     if type == "text" and spec.uri == "-":
         return SingleFileSplit("-")
     if type == "text":

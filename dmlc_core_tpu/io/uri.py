@@ -12,9 +12,31 @@ The cache file gets a ``.splitN.partK`` suffix per shard
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Dict, List, Mapping, Tuple
 
-__all__ = ["URI", "URISpec"]
+__all__ = ["URI", "URISpec", "uri_int", "rejoin_query"]
+
+
+def uri_int(args: Mapping[str, str], key: str, default: int) -> int:
+    """Integer URI option with an error that names the bad parameter."""
+    from ..utils.logging import Error  # local import: logging imports nothing back
+
+    raw = args.get(key)
+    if raw is None:
+        return default
+    try:
+        return int(raw)
+    except (TypeError, ValueError):
+        raise Error(f"URI option {key}={raw!r} is not an integer") from None
+
+
+def rejoin_query(args: Mapping[str, str]) -> str:
+    """Re-serialize parsed URI args as ``?k=v&...`` ('' when empty) —
+    the inverse of URISpec's query parse, shared so option
+    serialization cannot drift between call sites."""
+    if not args:
+        return ""
+    return "?" + "&".join(f"{k}={v}" for k, v in args.items())
 
 
 class URI:

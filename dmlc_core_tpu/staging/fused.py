@@ -37,7 +37,7 @@ import numpy as np
 from ..data import native
 from ..io import split as io_split
 from ..io.filesystem import FileSystem
-from ..io.uri import URISpec
+from ..io.uri import URISpec, rejoin_query, uri_int
 from ..utils.logging import Error, check
 from .batcher import Batch, BatchSpec
 
@@ -431,23 +431,26 @@ class FusedEllRowRecBatches:
               "fused ELL path stages int32 indices")
         self.spec = spec
         uspec = URISpec(uri, part_index, num_parts)
-        # epoch shuffling rides the URI (?shuffle_parts=N&seed=S →
-        # InputSplitShuffle); it reorders sub-parts, so the sequential
-        # mmap fast path is only taken without it
-        shuffle_parts = int(uspec.args.get("shuffle_parts", 0))
-        seed = int(uspec.args.get("seed", 0))
+        # epoch shuffling (?shuffle_parts=N&seed=S) and count-indexed
+        # access (?index=...&shuffle=1) ride the URI; both reorder reads,
+        # so the sequential mmap fast path is only taken without them
+        shuffle_parts = uri_int(uspec.args, "shuffle_parts", 0)
         local = (
             _plain_local_path(uspec.uri)
             if num_parts == 1 and shuffle_parts == 0
+            and "index" not in uspec.args
             else None
         )
         self._mmap = local is not None
+        # forward path + query (fragment stripped, matching the mmap fast
+        # path): io_split.create resolves the sugar (shuffle_parts /
+        # index / seed) itself
         self._split = (
             _MmapRawChunks(local)
             if local is not None
             else io_split.create(
-                uspec.uri, part_index, num_parts, type="recordio",
-                num_shuffle_parts=shuffle_parts, seed=seed,
+                uspec.uri + rejoin_query(uspec.args),
+                part_index, num_parts, type="recordio",
             )
         )
         B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]

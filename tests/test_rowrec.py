@@ -388,3 +388,112 @@ def test_shuffle_with_cachefile_refused(tmp_path):
         create_row_block_iter(
             path + "?format=rowrec&shuffle_parts=4#" + str(tmp_path / "cache")
         )
+
+
+def test_indexed_rowrec_via_uri_sugar(tmp_path):
+    """?index=<uri>&shuffle=1 reaches count-indexed sharding + per-epoch
+    shuffled batched reads from any rowrec consumer (reference
+    indexed_recordio_split.cc semantics through the URI)."""
+    from dmlc_core_tpu.staging import ell_batches
+
+    n, k = 300, 4
+    rng = np.random.default_rng(22)
+    blk = RowBlock(
+        offset=np.arange(n + 1, dtype=np.int64) * k,
+        label=np.arange(n, dtype=np.float32),
+        index=rng.integers(0, 80, n * k).astype(np.uint32),
+        value=rng.normal(size=n * k).astype(np.float32),
+    )
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.rec.idx")
+    with FileStream(rec, "w") as f, FileStream(idx, "w") as fi:
+        assert write_rowrec(f, [blk], index_stream=fi) == n
+
+    spec = lambda: BatchSpec(batch_size=50, layout="ell", max_nnz=k)
+
+    def labels(uri):
+        stream = ell_batches(uri, spec())
+        out = []
+        for b in stream:
+            out.extend(b.labels[: b.n_valid].tolist())
+        stream.close()
+        return out
+
+    # count-based sharding: EXACT halves regardless of byte sizes
+    p0 = labels(f"{rec}?index={idx}")
+    s0 = ell_batches(f"{rec}?index={idx}", spec(), part_index=0, num_parts=2)
+    s1 = ell_batches(f"{rec}?index={idx}", spec(), part_index=1, num_parts=2)
+    half0 = [x for b in s0 for x in b.labels[: b.n_valid].tolist()]
+    half1 = [x for b in s1 for x in b.labels[: b.n_valid].tolist()]
+    s0.close(); s1.close()
+    assert sorted(p0) == list(range(n))
+    assert len(half0) == len(half1) == n // 2
+    assert sorted(half0 + half1) == list(range(n))
+
+    # shuffled reads: full coverage, deterministic per seed, reordered
+    sh1 = labels(f"{rec}?index={idx}&shuffle=1&seed=5")
+    sh1b = labels(f"{rec}?index={idx}&shuffle=1&seed=5")
+    sh2 = labels(f"{rec}?index={idx}&shuffle=1&seed=6")
+    assert sorted(sh1) == list(range(n)) and sh1 == sh1b
+    assert sh1 != p0 and sh2 != sh1
+
+    # a cachefile would freeze the first epoch's shuffle order (same
+    # guard the shuffle_parts sugar has) → refused up front
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.utils.logging import Error as DmlcError
+
+    with pytest.raises(DmlcError, match="cachefile"):
+        io_split.create(
+            f"{rec}?index={idx}&shuffle=1#{tmp_path}/c", type="recordio"
+        )
+    with pytest.raises(DmlcError, match="shuffle="):
+        io_split.create(f"{rec}?index={idx}&shuffle=true", type="recordio")
+
+    # explicit kwargs beat URI options (None-sentinel contract)
+    s = io_split.create(
+        f"{rec}?index={idx}&batch_size=64&shuffle=1",
+        type="recordio", batch_size=32, shuffle=False, threaded=False,
+    )
+    assert s.batch_size == 32 and s.shuffle is False
+    s.close()
+
+
+def test_indexed_rowrec_sugar_on_parser_path(tmp_path):
+    """?index=&shuffle= must work through create_row_block_iter /
+    create_parser too, not only the fused native path: the registry
+    re-attaches query args so io_split.create is the single resolver."""
+    from dmlc_core_tpu.data import create_row_block_iter
+
+    n, k = 200, 2
+    rng = np.random.default_rng(7)
+    blk = RowBlock(
+        offset=np.arange(n + 1, dtype=np.int64) * k,
+        label=np.arange(n, dtype=np.float32),
+        index=rng.integers(0, 50, n * k).astype(np.uint32),
+        value=rng.normal(size=n * k).astype(np.float32),
+    )
+    rec = str(tmp_path / "p.rec")
+    idx = str(tmp_path / "p.rec.idx")
+    with FileStream(rec, "w") as f, FileStream(idx, "w") as fi:
+        write_rowrec(f, [blk], index_stream=fi)
+
+    def labels(uri, **kw):
+        it = create_row_block_iter(uri, **kw)
+        out = []
+        for b in it:
+            out.extend(np.asarray(b.label).tolist())
+        return out
+
+    base = f"{rec}?format=rowrec&index={idx}"
+    plain = labels(base)
+    assert sorted(plain) == list(range(n))
+    sh = labels(base + "&shuffle=1&seed=9")
+    assert sorted(sh) == list(range(n)) and sh != plain
+    # count-exact halves through the parser path as well
+    h0 = labels(base, part_index=0, num_parts=2)
+    h1 = labels(base, part_index=1, num_parts=2)
+    assert len(h0) == len(h1) == n // 2
+    assert sorted(h0 + h1) == list(range(n))
+    # shuffle + cachefile refused on this path too
+    with pytest.raises(Exception, match="cachefile|shuffl"):
+        create_row_block_iter(base + f"&shuffle=1#{tmp_path}/cache")
