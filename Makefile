@@ -25,6 +25,7 @@ check: lint native test sanitizers dryrun
 
 lint:
 	$(PY) -m compileall -q dmlc_core_tpu tests benchmarks bench.py __graft_entry__.py
+	$(PY) tools/lint.py
 
 native:
 	$(MAKE) -C native

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..io.split import InputSplit
 from ..params.parameter import Parameter, field
-from ..utils.logging import check, check_eq
+from ..utils.logging import check_eq
 from . import native
 from .row_block import INDEX_T, REAL_T, RowBlock
 from .strtonum import parse_float_token, parse_int_token, parse_pair

@@ -10,7 +10,7 @@ parsing onto a background thread with a bounded queue of 8 batches
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from ..concurrency.threaded_iter import ThreadedIter
 from ..params.registry import Registry

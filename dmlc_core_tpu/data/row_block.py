@@ -12,13 +12,13 @@ small consumers, mirroring ``RowBlock::operator[]`` (data.h:364-382).
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..io import serializer
 from ..io.stream import Stream
-from ..utils.logging import check, check_eq, check_lt
+from ..utils.logging import check, check_eq
 
 __all__ = ["Row", "RowBlock", "RowBlockContainer", "REAL_T", "INDEX_T"]
 

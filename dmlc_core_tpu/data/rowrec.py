@@ -45,7 +45,7 @@ from ..io.recordio import (
     RecordIOWriter,
 )
 from ..io.stream import Stream
-from ..utils.logging import Error, check
+from ..utils.logging import check
 from .parser import Parser
 from .row_block import RowBlock
 

@@ -20,11 +20,11 @@ from __future__ import annotations
 import os
 import shutil
 import tempfile
-from typing import Dict, Iterator, List, NamedTuple
+from typing import Dict, List, NamedTuple
 
 from ..params.registry import Registry
-from ..utils.logging import Error, check
-from .stream import FileStream, MemoryStream, SeekStream, Stream
+from ..utils.logging import Error
+from .stream import FileStream, MemoryStream, Stream
 from .uri import URI
 
 __all__ = [

@@ -27,7 +27,7 @@ reference implementation the native kernel's parity tests check against.
 from __future__ import annotations
 
 import struct
-from typing import Iterator, List, Optional, Tuple
+from typing import Iterator, List, Optional
 
 import numpy as np
 

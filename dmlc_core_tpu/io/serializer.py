@@ -18,11 +18,11 @@ TPU-native extension used by RowBlockContainer page caches.
 from __future__ import annotations
 
 import struct
-from typing import Any, BinaryIO, Dict, List, Tuple, Union
+from typing import Any, Union
 
 import numpy as np
 
-from ..utils.logging import Error, check
+from ..utils.logging import Error
 from .stream import Stream
 
 __all__ = [

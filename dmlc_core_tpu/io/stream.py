@@ -13,7 +13,6 @@ the factory (reference Stream::Create, src/io.cc:132-138).
 from __future__ import annotations
 
 import io as _pyio
-import os
 from typing import Optional, Union
 
 from ..utils.logging import Error, check

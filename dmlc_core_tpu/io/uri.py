@@ -12,7 +12,7 @@ The cache file gets a ``.splitN.partK`` suffix per shard
 
 from __future__ import annotations
 
-from typing import Dict, List, Mapping, Tuple
+from typing import Dict, Mapping
 
 __all__ = ["URI", "URISpec", "uri_int", "rejoin_query"]
 

@@ -8,7 +8,7 @@ zero-padded rows are no-ops (staging/batcher.py contract).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp

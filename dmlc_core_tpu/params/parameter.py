@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import difflib
 import json
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Type
+from typing import Any, Dict, List, Optional, Sequence, Type
 
 from ..utils.common import parse_bool
 from ..utils.logging import Error

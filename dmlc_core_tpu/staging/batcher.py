@@ -18,7 +18,7 @@ as the validity mask (padding rows contribute zero loss).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+from dataclasses import dataclass
 from typing import Dict, Iterator, Optional
 
 import numpy as np

@@ -11,7 +11,6 @@ from __future__ import annotations
 import json
 from typing import Dict, List
 
-from .. import tracker
 from . import run_tracker_submit
 
 

@@ -12,7 +12,6 @@ import subprocess
 import threading
 from typing import Dict, List
 
-from .. import tracker
 from . import run_tracker_submit
 
 

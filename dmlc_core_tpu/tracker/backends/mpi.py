@@ -13,7 +13,6 @@ import subprocess
 import threading
 from typing import Dict, List, Optional
 
-from .. import tracker
 from . import run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")

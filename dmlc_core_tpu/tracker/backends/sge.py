@@ -10,7 +10,6 @@ import os
 import subprocess
 from typing import Dict, List
 
-from .. import tracker
 from . import run_tracker_submit
 
 

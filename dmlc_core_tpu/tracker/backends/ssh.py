@@ -13,7 +13,6 @@ import subprocess
 import threading
 from typing import Dict, List, Tuple
 
-from .. import tracker
 from . import format_env_exports, run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")

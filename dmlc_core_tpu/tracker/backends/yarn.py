@@ -23,7 +23,6 @@ import os
 import subprocess
 from typing import Dict, List
 
-from .. import tracker
 from ..opts import get_cache_file_set
 from . import run_tracker_submit
 

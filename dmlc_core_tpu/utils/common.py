@@ -13,7 +13,6 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional, Sequence
 
-from .logging import Error
 
 
 def split_string(s: str, delim: str) -> List[str]:

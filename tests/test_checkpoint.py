@@ -4,7 +4,6 @@ resume equivalence, and checkpointing to (fake) S3."""
 import os
 
 import numpy as np
-import pytest
 
 from dmlc_core_tpu.checkpoint import Checkpointer, load_pytree, save_pytree
 

@@ -14,14 +14,11 @@ import urllib.parse
 from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-import numpy as np
 import pytest
 
-from dmlc_core_tpu.io import cloudfs, split as io_split
+from dmlc_core_tpu.io import split as io_split
 from dmlc_core_tpu.io.cloudfs import (
     GCSFileSystem,
-    HttpReadStream,
-    S3FileSystem,
     SigV4Signer,
     WebHdfsFileSystem,
     reset_singletons,

@@ -6,8 +6,6 @@ LibSVMParser → FixedShapeBatcher('dense') composed, across formats'
 edge cases. Skipped wholesale when the native kernel isn't built.
 """
 
-import os
-import tempfile
 
 import numpy as np
 import pytest

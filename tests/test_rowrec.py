@@ -13,7 +13,6 @@ and records straddling chunk windows mirror the reference's stress cases
 
 import os
 import struct
-import tempfile
 
 import numpy as np
 import pytest
@@ -29,7 +28,6 @@ from dmlc_core_tpu.data.rowrec import (
 from dmlc_core_tpu.data.row_block import RowBlock
 from dmlc_core_tpu.io.recordio import (
     KMAGIC,
-    RecordIOChunkReader,
     RecordIOReader,
     RecordIOWriter,
 )

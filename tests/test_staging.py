@@ -6,7 +6,6 @@ import pytest
 
 from dmlc_core_tpu.data.row_block import RowBlock
 from dmlc_core_tpu.staging import (
-    Batch,
     BatchSpec,
     FixedShapeBatcher,
     StagingPipeline,

@@ -8,7 +8,6 @@ rank through the tracker's jobid memo + recover path, and finish."""
 
 import os
 import sys
-import time
 
 import pytest
 

@@ -5,7 +5,6 @@ backend command builders, and the dmlc-submit CLI."""
 
 import os
 import socket
-import subprocess
 import sys
 import threading
 import time
@@ -14,7 +13,7 @@ import pytest
 
 from dmlc_core_tpu.tracker import topology
 from dmlc_core_tpu.tracker.client import RabitWorker
-from dmlc_core_tpu.tracker.tracker import PSTracker, RabitTracker
+from dmlc_core_tpu.tracker.tracker import RabitTracker
 from dmlc_core_tpu.tracker import opts as tracker_opts
 from dmlc_core_tpu.tracker.backends import (
     get_backend,

@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""AST lint gate for the repo (reference scripts/lint.py via
+travis_script.sh:19-23 — the reference runs a pylint-style pass per
+commit; this is the dependency-free equivalent for this tree).
+
+Checks (each finding is `path:line: code message`, exit 1 on any):
+  L001 unused import            (name imported but never referenced;
+                                 `__all__` strings and re-export aliases
+                                 like `import x as x` count as uses)
+  L002 bare except              (`except:` hides SystemExit/KeyboardInterrupt;
+                                 use `except Exception:` at minimum)
+  L003 mutable default argument (def f(x=[]) shares state across calls)
+  L004 f-string without placeholders (usually a forgotten format arg)
+  L005 duplicate dict key       (silently drops the earlier value)
+
+Run: python tools/lint.py [paths...]   (default: the repo's source roots)
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import Iterator, List, Tuple
+
+REPO = Path(__file__).resolve().parent.parent
+DEFAULT_PATHS = [
+    "dmlc_core_tpu",
+    "tests",
+    "benchmarks",
+    "tools",
+    "examples",
+    "bench.py",
+    "__graft_entry__.py",
+]
+
+Finding = Tuple[str, int, str, str]  # path, line, code, message
+
+
+def _py_files(paths: List[str]) -> Iterator[Path]:
+    for p in paths:
+        path = (REPO / p) if not Path(p).is_absolute() else Path(p)
+        if path.is_dir():
+            yield from sorted(path.rglob("*.py"))
+        elif path.suffix == ".py":
+            yield path
+
+
+def _names_loaded(tree: ast.AST) -> set:
+    """Every identifier the module references outside import statements,
+    plus attribute roots (`os.path` uses `os`) and `__all__` strings."""
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            root = node
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name):
+                used.add(root.id)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                    for elt in ast.walk(node.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            used.add(elt.value)
+    return used
+
+
+def _check_unused_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    used = _names_loaded(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.partition(".")[0]
+                if alias.asname == alias.name:
+                    continue  # `import x as x` is a deliberate re-export
+                if bound not in used:
+                    yield node.lineno, f"unused import '{alias.name}'"
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                if alias.asname == alias.name:
+                    continue  # PEP 484 re-export idiom
+                bound = alias.asname or alias.name
+                if bound not in used:
+                    yield node.lineno, f"unused import '{alias.name}'"
+
+
+def _check_bare_except(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            yield node.lineno, "bare 'except:' (catch Exception instead)"
+
+
+def _check_mutable_defaults(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None
+            ]:
+                if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                    yield default.lineno, (
+                        f"mutable default argument in '{node.name}()'"
+                    )
+
+
+def _check_fstring_no_placeholder(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    # a FormattedValue's format_spec is itself a JoinedStr (usually all
+    # constants, e.g. the ".4f" in f"{x:.4f}") — not a reportable f-string
+    specs = {
+        id(node.format_spec)
+        for node in ast.walk(tree)
+        if isinstance(node, ast.FormattedValue) and node.format_spec is not None
+    }
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.JoinedStr)
+            and id(node) not in specs
+            and not any(isinstance(v, ast.FormattedValue) for v in node.values)
+        ):
+            yield node.lineno, "f-string without placeholders"
+
+
+def _check_duplicate_dict_keys(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Dict):
+            seen = set()
+            for key in node.keys:
+                if isinstance(key, ast.Constant):
+                    try:
+                        hash(key.value)
+                    except TypeError:
+                        continue
+                    if key.value in seen:
+                        yield key.lineno, f"duplicate dict key {key.value!r}"
+                    seen.add(key.value)
+
+
+CHECKS = [
+    ("L001", _check_unused_imports),
+    ("L002", _check_bare_except),
+    ("L003", _check_mutable_defaults),
+    ("L004", _check_fstring_no_placeholder),
+    ("L005", _check_duplicate_dict_keys),
+]
+
+
+def lint_file(path: Path) -> List[Finding]:
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as exc:  # compileall also catches this; belt+braces
+        return [(str(path), exc.lineno or 0, "L000", f"syntax error: {exc.msg}")]
+    # `# noqa` on a statement's first line suppresses its findings
+    # (flake8 convention; re-export blocks carry `# noqa: F401`)
+    noqa_lines = {
+        i
+        for i, text in enumerate(src.splitlines(), start=1)
+        if "# noqa" in text
+    }
+    out: List[Finding] = []
+    rel = str(path.relative_to(REPO)) if path.is_relative_to(REPO) else str(path)
+    for code, fn in CHECKS:
+        for line, msg in fn(tree):
+            if line not in noqa_lines:
+                out.append((rel, line, code, msg))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    paths = argv or DEFAULT_PATHS
+    findings: List[Finding] = []
+    n_files = 0
+    for f in _py_files(paths):
+        if "__pycache__" in f.parts:
+            continue
+        n_files += 1
+        findings.extend(lint_file(f))
+    findings.sort()
+    for path, line, code, msg in findings:
+        print(f"{path}:{line}: {code} {msg}")
+    print(
+        f"lint: {n_files} files, {len(findings)} finding(s)",
+        file=sys.stderr,
+    )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
