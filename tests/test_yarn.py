@@ -1,0 +1,305 @@
+"""YARN backend: RM REST submission path + the Python in-container AM.
+
+REST client/context against a mock ResourceManager (same mock-server
+technique as tests/test_cloudfs.py's WebHDFS coverage — reference has no
+REST path, its client is Java: tracker/yarn/src/.../Client.java); the AM
+tier proves tracker/yarn_am.py carries the Java AM's relaunch semantics
+(ApplicationMaster.java:537-569) for in-container tasks."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_core_tpu.tracker import opts as tracker_opts
+from dmlc_core_tpu.tracker.backends.yarn import (
+    YarnRestClient,
+    build_rest_context,
+    submit_via_rest,
+)
+from dmlc_core_tpu.tracker.yarn_am import task_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class MockRM:
+    """Threaded mock of the RM 'Cluster Applications API' endpoints the
+    backend uses; records every submission context it accepts."""
+
+    def __init__(self, states=("ACCEPTED", "RUNNING", "FINISHED")):
+        self.submitted = []
+        self.killed = []
+        self._states = list(states)
+        self._polls = 0
+        mock = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path == "/ws/v1/cluster/apps/new-application":
+                    self._json(200, {
+                        "application-id": "application_1_0001",
+                        "maximum-resource-capability": {
+                            "memory": 8192, "vCores": 4,
+                        },
+                    })
+                elif self.path == "/ws/v1/cluster/apps":
+                    n = int(self.headers["Content-Length"])
+                    mock.submitted.append(json.loads(self.rfile.read(n)))
+                    self._json(202, {})
+                else:
+                    self._json(404, {"error": self.path})
+
+            def do_GET(self):
+                if self.path.endswith("/state"):
+                    i = min(mock._polls, len(mock._states) - 1)
+                    mock._polls += 1
+                    if mock._states[i] == "ERR":  # scripted RM blip
+                        self._json(503, {"error": "rm restarting"})
+                        return
+                    self._json(200, {"state": mock._states[i]})
+                elif "/ws/v1/cluster/apps/" in self.path:
+                    self._json(200, {"app": {
+                        "state": mock._states[-1],
+                        "finalStatus": "SUCCEEDED",
+                    }})
+                else:
+                    self._json(404, {"error": self.path})
+
+            def do_PUT(self):
+                if self.path.endswith("/state"):
+                    n = int(self.headers["Content-Length"])
+                    mock.killed.append(json.loads(self.rfile.read(n)))
+                    self._json(200, {"state": "KILLED"})
+                else:
+                    self._json(404, {"error": self.path})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def mock_rm():
+    rm = MockRM()
+    yield rm
+    rm.close()
+
+
+def _args(extra=()):
+    return tracker_opts.get_opts(
+        ["--cluster", "yarn", "--num-workers", "2", "--num-servers", "1",
+         "--worker-memory", "1g", "--server-memory", "512m",
+         "--worker-cores", "1", "--server-cores", "1", *extra, "true"]
+    )
+
+
+def test_rest_client_roundtrip(mock_rm):
+    c = YarnRestClient(mock_rm.url)
+    fresh = c.new_application()
+    assert fresh["application-id"] == "application_1_0001"
+    assert fresh["maximum-resource-capability"]["memory"] == 8192
+    c.submit_application({"application-id": fresh["application-id"]})
+    assert mock_rm.submitted[0]["application-id"] == "application_1_0001"
+    assert c.state("application_1_0001") == "ACCEPTED"
+    assert c.report("application_1_0001")["finalStatus"] == "SUCCEEDED"
+    c.kill("application_1_0001")
+    assert mock_rm.killed == [{"state": "KILLED"}]
+
+
+def test_rest_client_errors_name_the_endpoint(mock_rm):
+    c = YarnRestClient(mock_rm.url)
+    with pytest.raises(RuntimeError, match="HTTP 404"):
+        c._request("POST", "/ws/v1/cluster/nope")
+    dead = YarnRestClient("http://127.0.0.1:1")
+    with pytest.raises(RuntimeError, match="unreachable"):
+        dead.new_application()
+
+
+def test_rest_context_contract():
+    """Submission context carries the DMLC env, the AM command wrapping
+    the user command, job-wide resources clamped to cluster caps, and
+    the DMLC_MAX_ATTEMPT relaunch budget."""
+    args = _args()
+    envs = {"DMLC_TRACKER_URI": "10.0.0.5", "DMLC_TRACKER_PORT": 9091,
+            "DMLC_NUM_WORKER": 2, "DMLC_NUM_SERVER": 1}
+    ctx = build_rest_context(
+        args, "application_1_0001", envs,
+        max_caps={"memory": 2100, "vCores": 4},
+    )
+    assert ctx["application-id"] == "application_1_0001"
+    assert ctx["application-type"] == "DMLC-TPU"
+    assert ctx["queue"] == "default"
+    assert ctx["max-app-attempts"] == 3
+    # 2*1024 + 512 = 2560 clamped to the 2100 cap; 3 vCores under the 4 cap
+    assert ctx["resource"] == {"memory": 2100, "vCores": 3}
+    cmd = ctx["am-container-spec"]["commands"]["command"]
+    assert "-m dmlc_core_tpu.tracker.yarn_am true" in cmd
+    assert "<LOG_DIR>" in cmd
+    env = {
+        e["key"]: e["value"]
+        for e in ctx["am-container-spec"]["environment"]["entry"]
+    }
+    assert env["DMLC_TRACKER_URI"] == "10.0.0.5"
+    assert env["DMLC_NUM_WORKER"] == "2"
+    assert env["DMLC_JOB_CLUSTER"] == "yarn"
+    assert env["DMLC_MAX_ATTEMPT"] == "3"
+
+
+def test_rest_submit_end_to_end(mock_rm):
+    """submit_via_rest drives new-application → submit → poll on the
+    mock RM against a REAL tracker rendezvous. No worker ever connects
+    here, so the app FINISHing successfully must abort the join with a
+    clear error (anti-wedge) rather than hanging forever."""
+    args = _args()
+    args.num_servers = 0  # rabit branch polls abort_check
+    with pytest.raises(RuntimeError, match="never completed"):
+        submit_via_rest(args, mock_rm.url, poll_interval=0.01)
+    ctx = mock_rm.submitted[0]
+    assert ctx["application-id"] == "application_1_0001"
+    # caps from new-application were applied
+    assert ctx["resource"]["memory"] <= 8192
+
+
+def test_rest_submit_failed_app_aborts_join():
+    rm = MockRM(states=("ACCEPTED", "FAILED"))
+    try:
+        args = _args()
+        args.num_servers = 0
+        with pytest.raises(RuntimeError, match="FAILED"):
+            submit_via_rest(args, rm.url, poll_interval=0.01)
+        # aborting the join must not leak the application on the cluster
+        assert rm.killed == [{"state": "KILLED"}]
+    finally:
+        rm.close()
+
+
+def test_rest_poll_tolerates_transient_rm_blips():
+    """Brief RM unavailability (scripted 503s) must not abort the job;
+    the real terminal state after the blip is what's reported."""
+    rm = MockRM(states=("ACCEPTED", "ERR", "ERR", "FAILED"))
+    try:
+        args = _args()
+        args.num_servers = 0
+        with pytest.raises(RuntimeError, match="FAILED"):
+            submit_via_rest(args, rm.url, poll_interval=0.01)
+    finally:
+        rm.close()
+
+
+def test_rest_context_quotes_command_args():
+    args = _args()
+    args.command = ["python", "train.py", "--name", "run 1"]
+    ctx = build_rest_context(args, "app_1", {})
+    cmd = ctx["am-container-spec"]["commands"]["command"]
+    assert "--name 'run 1'" in cmd
+
+
+def test_rest_dry_run_prints_context(capsys, monkeypatch):
+    monkeypatch.setenv("DMLC_YARN_REST", "http://rm.invalid:8088")
+    from dmlc_core_tpu.tracker.backends import yarn as yarn_backend
+
+    args = _args(["--dry-run"])
+    yarn_backend.submit(args)
+    out = capsys.readouterr().out
+    assert "POST http://rm.invalid:8088/ws/v1/cluster/apps" in out
+    ctx = json.loads(out[out.index("{"):])
+    assert ctx["application-name"] == "dmlc-tpu-job"
+
+
+# -- the Python AM ------------------------------------------------------------
+
+def test_task_env_strips_role_sets_task_id():
+    env = task_env({"DMLC_ROLE": "worker", "X": "1"}, 3)
+    assert "DMLC_ROLE" not in env
+    assert env["DMLC_TASK_ID"] == "3" and env["X"] == "1"
+
+
+AM_TASK = r"""
+import os, sys
+marker = os.path.join(
+    os.environ["AM_TEST_DIR"],
+    f"t{os.environ['DMLC_TASK_ID']}.a{os.environ['DMLC_NUM_ATTEMPT']}."
+    + os.environ["DMLC_ROLE"],
+)
+open(marker, "w").close()
+# task 1 fails on its first attempt only → must be relaunched
+if os.environ["DMLC_TASK_ID"] == "1" and os.environ["DMLC_NUM_ATTEMPT"] == "0":
+    sys.exit(9)
+"""
+
+
+def _run_am(tmp_path, env_extra, code=AM_TASK):
+    script = tmp_path / "task.py"
+    script.write_text(code)
+    env = os.environ.copy()
+    env.update(
+        AM_TEST_DIR=str(tmp_path),
+        PYTHONPATH=REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        **env_extra,
+    )
+    return subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.yarn_am",
+         sys.executable, str(script)],
+        env=env, capture_output=True, text=True, timeout=120,
+    ), sorted(p.name for p in tmp_path.glob("t*.a*"))
+
+
+def test_yarn_am_supervises_and_relaunches(tmp_path):
+    """3 tasks in-container: roles derived from task id, the crashing
+    task relaunched with DMLC_NUM_ATTEMPT bumped, job exits 0."""
+    proc, markers = _run_am(
+        tmp_path,
+        {"DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "1",
+         "DMLC_MAX_ATTEMPT": "3"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert markers == [
+        "t0.a0.worker", "t1.a0.worker", "t1.a1.worker", "t2.a0.server"
+    ]
+
+
+def test_yarn_am_aborts_past_budget(tmp_path):
+    always_fail = AM_TASK.replace(
+        'and os.environ["DMLC_NUM_ATTEMPT"] == "0"', ""
+    )
+    proc, markers = _run_am(
+        tmp_path,
+        {"DMLC_NUM_WORKER": "2", "DMLC_NUM_SERVER": "0",
+         "DMLC_MAX_ATTEMPT": "2"},
+        code=always_fail,
+    )
+    assert proc.returncode == 1
+    assert "aborted" in proc.stderr
+    # task 1 burned exactly its 2-attempt budget
+    assert markers.count("t1.a0.worker") == 1 and "t1.a1.worker" in markers
+    assert "t1.a2.worker" not in markers
+
+
+def test_jar_path_error_mentions_rest_alternative(monkeypatch):
+    monkeypatch.delenv("DMLC_YARN_REST", raising=False)
+    monkeypatch.delenv("HADOOP_HOME", raising=False)
+    from dmlc_core_tpu.tracker.backends import yarn as yarn_backend
+
+    args = _args()
+    args.num_servers = 0  # rabit branch: launch_all runs and raises
+    with pytest.raises(RuntimeError, match="DMLC_YARN_REST"):
+        yarn_backend.submit(args)
