@@ -1,0 +1,233 @@
+"""URI-addressed command-line tools: ``python -m dmlc_core_tpu.tools``.
+
+Reference: the Tier-2 standalone CLI test programs under test/*.cc —
+``filesys_test`` (mini ls/cat/cp against any URI, filesys_test.cc:8-40),
+``split_test``/``split_read_test`` (stream one part of a sharded URI,
+split_test.cc:8-24), ``recordio_test`` (pack/unpack roundtrip). Rebuilt
+as one argparse CLI over the same URI machinery users get from the
+library, plus ``rowrec pack``: text (libsvm/csv/libfm) → .rec [+ index]
+conversion for the RecordIO→HBM staging path (BASELINE.md north star
+#2), which the reference leaves to downstream projects.
+
+Every subcommand accepts any registered URI scheme (file, s3, gs, hdfs,
+azure, http, mem) — the point of the reference tools.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import sys
+from typing import List, Optional
+
+from ..data import create_parser
+from ..data.rowrec import write_rowrec
+from ..io import split as io_split
+from ..io.filesystem import FileSystem
+from ..io.stream import Stream
+from ..utils.logging import Error
+
+__all__ = ["main"]
+
+_COPY_CHUNK = 4 << 20
+
+
+def _cmd_ls(args) -> int:
+    fs = FileSystem.get_instance(args.uri)
+    lister = (
+        fs.list_directory_recursive if args.recursive else fs.list_directory
+    )
+    for info in lister(args.uri):
+        print(f"{info.size:>12}  {info.path}")
+    return 0
+
+
+def _cmd_cat(args) -> int:
+    with Stream.create(args.uri, "r") as s:
+        while True:
+            buf = s.read(_COPY_CHUNK)
+            if not buf:
+                break
+            sys.stdout.buffer.write(buf)
+    sys.stdout.buffer.flush()
+    return 0
+
+
+def _cmd_cp(args) -> int:
+    with Stream.create(args.src, "r") as src, Stream.create(
+        args.dst, "w"
+    ) as dst:
+        n = 0
+        while True:
+            buf = src.read(_COPY_CHUNK)
+            if not buf:
+                break
+            dst.write(buf)
+            n += len(buf)
+    print(f"copied {n} bytes {args.src} -> {args.dst}", file=sys.stderr)
+    return 0
+
+
+def _cmd_split(args) -> int:
+    """Stream one shard of a URI — record counts/bytes like
+    split_test.cc, with --dump echoing the records themselves."""
+    sp = io_split.create(
+        args.uri, args.part, args.num_parts, type=args.type, threaded=False
+    )
+    records = 0
+    nbytes = 0
+    try:
+        while True:
+            rec = sp.next_record()
+            if rec is None:
+                break
+            records += 1
+            nbytes += len(rec)
+            if args.dump:
+                sys.stdout.buffer.write(bytes(rec))
+                if args.type == "text":
+                    sys.stdout.buffer.write(b"\n")
+    finally:
+        sp.close()
+    print(
+        f"part {args.part}/{args.num_parts}: {records} records, "
+        f"{nbytes} bytes",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _cmd_recordio(args) -> int:
+    """pack: one record per input line; unpack: records back to lines
+    (recordio_test.cc roundtrip, binary-safe via the frame format)."""
+    from ..io.recordio import (
+        IndexedRecordIOWriter,
+        RecordIOReader,
+        RecordIOWriter,
+    )
+
+    if args.action == "pack":
+        if not args.dst:
+            print("error: recordio pack needs a dst URI", file=sys.stderr)
+            return 2
+        with contextlib.ExitStack() as stack:
+            dst = stack.enter_context(Stream.create(args.dst, "w"))
+            writer = (
+                IndexedRecordIOWriter(
+                    dst, stack.enter_context(Stream.create(args.index, "w"))
+                )
+                if args.index
+                else RecordIOWriter(dst)
+            )
+            n = _pack_lines(args.src, writer)
+        print(f"packed {n} records", file=sys.stderr)
+    else:
+        with Stream.create(args.src, "r") as src:
+            n = 0
+            for rec in RecordIOReader(src):
+                sys.stdout.buffer.write(rec)
+                sys.stdout.buffer.write(b"\n")
+                n += 1
+        print(f"unpacked {n} records", file=sys.stderr)
+    return 0
+
+
+def _pack_lines(src_uri: str, writer) -> int:
+    """One record per line, streamed through the text splitter. Blank
+    lines are NOT records: reference LineSplitter collapses runs of
+    \\n/\\r (line_split.cc:42-44), and this CLI keeps its semantics —
+    byte-faithful payloads belong in RecordIO directly, not line form."""
+    sp = io_split.create(src_uri, 0, 1, type="text", threaded=False)
+    n = 0
+    try:
+        while True:
+            line = sp.next_record()
+            if line is None:
+                return n
+            writer.write_record(bytes(line))
+            n += 1
+    finally:
+        sp.close()
+
+
+def _cmd_rowrec(args) -> int:
+    """Text dataset → rowrec .rec shards (+ optional count index) for
+    the fused RecordIO→HBM staging path."""
+    parser = create_parser(args.src, type=args.format, threaded=False)
+    try:
+        with contextlib.ExitStack() as stack:
+            dst = stack.enter_context(Stream.create(args.dst, "w"))
+            idx = (
+                stack.enter_context(Stream.create(args.index, "w"))
+                if args.index
+                else None
+            )
+            n = write_rowrec(dst, iter(parser), index_stream=idx)
+    finally:
+        parser.close()
+    print(f"wrote {n} rows to {args.dst}", file=sys.stderr)
+    return 0
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m dmlc_core_tpu.tools",
+        description=__doc__.splitlines()[0],
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ls = sub.add_parser("ls", help="list a directory URI")
+    ls.add_argument("uri")
+    ls.add_argument("-r", "--recursive", action="store_true")
+    ls.set_defaults(fn=_cmd_ls)
+
+    cat = sub.add_parser("cat", help="print a URI's bytes to stdout")
+    cat.add_argument("uri")
+    cat.set_defaults(fn=_cmd_cat)
+
+    cp = sub.add_parser("cp", help="copy src URI to dst URI")
+    cp.add_argument("src")
+    cp.add_argument("dst")
+    cp.set_defaults(fn=_cmd_cp)
+
+    spl = sub.add_parser("split", help="stream one shard of a URI")
+    spl.add_argument("uri")
+    spl.add_argument("part", type=int)
+    spl.add_argument("num_parts", type=int)
+    spl.add_argument(
+        "--type", default="text",
+        choices=("text", "recordio", "indexed_recordio"),
+    )
+    spl.add_argument("--dump", action="store_true",
+                     help="echo records to stdout")
+    spl.set_defaults(fn=_cmd_split)
+
+    rio = sub.add_parser("recordio", help="pack/unpack line records")
+    rio.add_argument("action", choices=("pack", "unpack"))
+    rio.add_argument("src")
+    rio.add_argument("dst", nargs="?", default="",
+                     help="output URI (pack); unpack prints to stdout")
+    rio.add_argument("--index", default="",
+                     help="also write a count index (pack only)")
+    rio.set_defaults(fn=_cmd_recordio)
+
+    rr = sub.add_parser(
+        "rowrec", help="convert a text dataset to rowrec .rec"
+    )
+    rr.add_argument("src", help="source URI (?format= honored)")
+    rr.add_argument("dst", help="output .rec URI")
+    rr.add_argument("--format", default="auto",
+                    choices=("auto", "libsvm", "csv", "libfm"))
+    rr.add_argument("--index", default="",
+                    help="also write a count index")
+    rr.set_defaults(fn=_cmd_rowrec)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except (Error, OSError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
