@@ -22,6 +22,7 @@ from .filesystem import (  # noqa: F401
 from .recordio import (  # noqa: F401
     KMAGIC,
     RecordIOWriter,
+    IndexedRecordIOWriter,
     RecordIOReader,
     RecordIOChunkReader,
 )

@@ -372,6 +372,7 @@ def test_sharded_fused_libsvm_exact_cover(tmp_path):
     assert sharded_stream.rows_out == n
 
 
+@pytest.mark.jax
 def test_sharded_fused_rowrec_through_pipeline(tmp_path):
     """Threaded ELL fan-out through the staging pipeline: every label
     lands exactly once on device."""
