@@ -38,7 +38,10 @@ import numpy as np
 N_ROWS = int(os.environ.get("BENCH_ROWS", "400000"))
 N_FEATURES = 28  # HIGGS
 EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
-BATCH = int(os.environ.get("BENCH_BATCH", "8192"))
+# 32768 measured best on the tunneled v5e frontend: ~5% over 8192 fresh
+# and ~1.5x under sustained-transfer throttling (fewer, larger DMAs);
+# 65536 regressed. Sweep recorded 2026-07-30, PROGRESS round 3.
+BATCH = int(os.environ.get("BENCH_BATCH", "32768"))
 # parse fan-out: >1 engages ShardedFusedBatches (threads; native kernels
 # release the GIL). Defaults to the core count on multi-core TPU hosts,
 # capped PER STREAM so every sub-shard still covers several full batches
