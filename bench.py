@@ -42,6 +42,10 @@ EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 # and ~1.5x under sustained-transfer throttling (fewer, larger DMAs);
 # 65536 regressed. Sweep recorded 2026-07-30, PROGRESS round 3.
 BATCH = int(os.environ.get("BENCH_BATCH", "32768"))
+# producer ring sized for the depth-3 pipeline below INCLUDING the
+# sharded fan-out case: ShardedFusedBatches advertises ring-(prefetch+1)
+# slots, and StagingPipeline(depth=3, prefetch=2) needs 6 alive
+_RING = 12
 # parse fan-out: >1 engages ShardedFusedBatches (threads; native kernels
 # release the GIL). Defaults to the core count on multi-core TPU hosts,
 # capped PER STREAM so every sub-shard still covers several full batches
@@ -215,7 +219,7 @@ def _make_higgs_stream(value_dtype: str):
         value_dtype=np.dtype(value_dtype),
     )
     return (
-        dense_batches(DATA, spec, nthread=_nthread_for(N_ROWS)),
+        dense_batches(DATA, spec, nthread=_nthread_for(N_ROWS), ring=_RING),
         "x",
         DATA,
     )
@@ -262,7 +266,7 @@ def _make_csv_stream(value_dtype: str):
     return (
         dense_batches(
             CSV_DATA + "?format=csv&label_column=0", spec,
-            nthread=_nthread_for(N_ROWS),
+            nthread=_nthread_for(N_ROWS), ring=_RING,
         ),
         "x",
         CSV_DATA,
@@ -279,7 +283,7 @@ def _make_rec_stream(value_dtype: str):
         value_dtype=np.dtype(value_dtype),
     )
     return (
-        ell_batches(REC_DATA, spec, nthread=_nthread_for(REC_ROWS)),
+        ell_batches(REC_DATA, spec, nthread=_nthread_for(REC_ROWS), ring=_RING),
         "values",
         REC_DATA,
     )
@@ -292,7 +296,10 @@ def run_epoch(make_stream, value_dtype: str) -> dict:
     from dmlc_core_tpu.staging import StagingPipeline
 
     stream, block_key, data_path = make_stream(value_dtype)
-    pipe = StagingPipeline(stream, depth=2)
+    # depth 3 measured ~3% over depth 2 steady-state on the tunneled
+    # frontend (deeper in-flight window rides out link jitter); 4 was
+    # equal at more HBM. Ring (8 slots) stays > prefetch+depth.
+    pipe = StagingPipeline(stream, depth=3)
     t0 = time.perf_counter()
     last = None
     for dev in pipe:
