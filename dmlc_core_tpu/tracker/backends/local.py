@@ -70,7 +70,15 @@ def submit(args) -> None:
             max_attempt=default_max_attempt(args.local_num_attempt + 1),
             host_fail_limit=float("inf"),
         )
-        checks.append(sup.run_in_thread(nworker + nserver, "local-supervisor"))
+        # the tasks-exited-but-rendezvous-never-completed heuristic only
+        # holds on the rabit path; the PS tracker joins a scheduler
+        # process whose teardown can legitimately outlive the tasks
+        checks.append(
+            sup.run_in_thread(
+                nworker + nserver, "local-supervisor",
+                grace=None if nserver == 0 else float("inf"),
+            )
+        )
 
     run_tracker_submit(
         args, launch_all,

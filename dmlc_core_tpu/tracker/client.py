@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import os
 import socket
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .protocol import MAGIC, FramedSocket
@@ -125,11 +126,54 @@ class RabitWorker:
                 break
         fs.send_int(my_port)
         fs.close()
-        for _ in range(n_wait):
-            peer, _addr = self._listener.accept()
-            peer_rank = FramedSocket(peer).recv_int()
-            self.links[peer_rank] = peer
+        self._await_peer_links(n_wait)
         return self.rank
+
+    def _await_peer_links(self, n_wait: int) -> None:
+        """Accept ``n_wait`` incoming peer links under one shared
+        deadline ($DMLC_LINK_WAIT_TIMEOUT seconds total, default 300;
+        <= 0 waits forever). A peer that never dials in (e.g. it wired
+        to a crashed predecessor and did not re-enter rendezvous — the
+        rabit recover contract asks survivors to re-join) or connects
+        without identifying must fail this worker loudly so a supervisor
+        can retry/abort, never hang the brokering forever. The deadline
+        spans accept() AND the identifying recv; on failure the listener
+        and this round's accepted links are closed, so a caller may
+        retry start() cleanly."""
+        raw = os.environ.get("DMLC_LINK_WAIT_TIMEOUT", "300")
+        try:
+            total = float(raw)
+        except ValueError:
+            total = 300.0
+        deadline = None if total <= 0 else time.monotonic() + total
+        accepted: List[socket.socket] = []
+        try:
+            for _ in range(n_wait):
+                if deadline is not None:
+                    self._listener.settimeout(
+                        max(0.001, deadline - time.monotonic())
+                    )
+                peer, _addr = self._listener.accept()
+                accepted.append(peer)
+                if deadline is not None:
+                    peer.settimeout(max(0.001, deadline - time.monotonic()))
+                peer_rank = FramedSocket(peer).recv_int()
+                peer.settimeout(None)
+                self.links[peer_rank] = peer
+        except (socket.timeout, TimeoutError):
+            for p in accepted:
+                p.close()
+                self.links = {
+                    r: s for r, s in self.links.items() if s is not p
+                }
+            self._listener.close()
+            raise RuntimeError(
+                f"rank {self.rank}: timed out after {total:.0f}s waiting "
+                f"for incoming peer link(s) ({n_wait} expected); if this "
+                "worker was relaunched, surviving peers must re-rendezvous "
+                "(start(recover_rank=...)) for links to re-wire; raise "
+                "$DMLC_LINK_WAIT_TIMEOUT for slow-starting clusters"
+            ) from None
 
     # -- control messages ----------------------------------------------------
     def log(self, msg: str) -> None:

@@ -178,18 +178,47 @@ class Supervisor:
             raise
 
     def run_in_thread(
-        self, n_tasks: int, label: str = "supervisor"
+        self, n_tasks: int, label: str = "supervisor", grace: Optional[float] = None
     ) -> Callable[[], Optional[BaseException]]:
         """Run on a daemon thread; returns an error-check callable suited
         for tracker.submit's ``abort_check`` (backends share this instead
-        of each re-implementing the holder/thread/lambda plumbing)."""
+        of each re-implementing the holder/thread/lambda plumbing).
+
+        Anti-wedge: when every task exits 0 the tracker join normally
+        returns moments later (the workers sent rabit shutdown). If it is
+        STILL polling ``grace`` seconds after the supervisor finished,
+        the command never completed the rendezvous (e.g. it is not a
+        dmlc/rabit client) — surface that instead of hanging forever,
+        which is what the reference does (tracker.py:293-311 wedge).
+        ``grace`` defaults to $DMLC_RENDEZVOUS_GRACE or 10s."""
+        if grace is None:
+            try:
+                grace = float(os.getenv("DMLC_RENDEZVOUS_GRACE", "10"))
+            except ValueError:
+                logger.warning("bad DMLC_RENDEZVOUS_GRACE; using 10s")
+                grace = 10.0
+        done_at: List[float] = []
 
         def body() -> None:
             try:
                 self.run(n_tasks)
+                done_at.append(time.monotonic())
             except Exception:
                 logger.exception("%s aborted the job", label)
 
+        def check_err() -> Optional[BaseException]:
+            if self.error is not None:
+                return self.error
+            if done_at and time.monotonic() - done_at[0] > grace:
+                return RuntimeError(
+                    f"all {n_tasks} task(s) exited 0 but the tracker "
+                    "rendezvous never completed — the launched command "
+                    "does not appear to be a dmlc/rabit client "
+                    "(raise $DMLC_RENDEZVOUS_GRACE if workers simply "
+                    "need longer to shut down)"
+                )
+            return None
+
         self._thread = threading.Thread(target=body, daemon=True, name=label)
         self._thread.start()
-        return lambda: self.error
+        return check_err

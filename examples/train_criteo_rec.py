@@ -44,8 +44,17 @@ def synth(path: str, rows: int = 20000) -> None:
         index=idx.reshape(-1),
         value=val.reshape(-1),
     )
-    with FileStream(path, "w") as f:
-        write_rowrec(f, [blk])
+    # the sidecar index enables count-exact sharding + shuffled epochs
+    # via `?index=<uri>&shuffle=1` (reference indexed_recordio semantics).
+    # multi-worker launches race through synth: write to per-process temp
+    # names, then atomically publish the index FIRST, so a worker that
+    # sees the data file always sees a complete index (content is
+    # deterministic, so concurrent publishers agree)
+    tmp, itmp = f"{path}.tmp{os.getpid()}", f"{path}.idx.tmp{os.getpid()}"
+    with FileStream(tmp, "w") as f, FileStream(itmp, "w") as fi:
+        write_rowrec(f, [blk], index_stream=fi)
+    os.replace(itmp, path + ".idx")
+    os.replace(tmp, path)
 
 
 def main() -> None:
@@ -60,9 +69,19 @@ def main() -> None:
         print(f"generating synthetic rowrec shard at {path}")
         synth(path)
 
-    # shard by worker rank when launched through dmlc-submit
-    rank = int(os.environ.get("DMLC_TASK_ID", 0))
-    world = int(os.environ.get("DMLC_NUM_WORKER", 1))
+    # under dmlc-submit, join the tracker rendezvous like any dmlc
+    # worker: the tracker assigns the rank we shard by (and relaunched
+    # workers reclaim theirs); standalone runs shard by env/defaults
+    worker = None
+    if os.environ.get("DMLC_TRACKER_URI"):
+        from dmlc_core_tpu.tracker.client import RabitWorker
+
+        worker = RabitWorker()
+        rank = worker.start()
+        world = worker.world_size
+    else:
+        rank = int(os.environ.get("DMLC_TASK_ID", 0))
+        world = int(os.environ.get("DMLC_NUM_WORKER", 1))
     model = FactorizationMachine(N_FEATURES, embed_dim=8)
     params = model.init(jax.random.PRNGKey(0))
     step = jax.jit(lambda p, b: model.sgd_step(p, b, lr=0.1))
@@ -75,8 +94,17 @@ def main() -> None:
     first_epoch = 0 if start is None else start + 1
 
     spec = BatchSpec(batch_size=2048, layout="ell", max_nnz=K)
+    # with a sidecar index, shards are count-exact and each epoch reads
+    # in a fresh shuffled order (URI sugar → IndexedRecordIOSplitter);
+    # without one, fall back to sequential byte-sharded reads
+    has_index = os.path.exists(path + ".idx")
     for epoch in range(first_epoch, first_epoch + 3):
-        stream = ell_batches(path, spec, part_index=rank, num_parts=world)
+        uri = (
+            f"{path}?index={path}.idx&shuffle=1&seed={epoch + 1}"
+            if has_index
+            else path
+        )
+        stream = ell_batches(uri, spec, part_index=rank, num_parts=world)
         pipe = StagingPipeline(stream)
         loss = None
         for batch in pipe:
@@ -92,6 +120,8 @@ def main() -> None:
         pipe.close()
         ck.save(epoch, params)
     print("latest checkpoint step:", ck.latest_step())
+    if worker is not None:
+        worker.shutdown()
 
 
 if __name__ == "__main__":

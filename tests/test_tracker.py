@@ -502,6 +502,113 @@ def test_local_submit_end_to_end(tmp_path):
     assert got == {0, 1}
 
 
+def test_worker_link_wait_times_out_not_wedges(monkeypatch):
+    """A worker told to await a peer link that never dials in must fail
+    with a diagnosis after DMLC_LINK_WAIT_TIMEOUT, never block forever
+    (the relaunched-worker wedge: survivors wired to a dead predecessor
+    won't reconnect unless the app re-enters rendezvous)."""
+    import socket as socket_mod
+    import threading
+
+    from dmlc_core_tpu.tracker.client import RabitWorker
+    from dmlc_core_tpu.tracker.protocol import MAGIC, FramedSocket
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+
+    def fake_tracker():
+        conn, _ = srv.accept()
+        fs = FramedSocket(conn)
+        assert fs.recv_int() == MAGIC
+        fs.send_int(MAGIC)
+        fs.recv_int()  # rank
+        fs.recv_int()  # world
+        fs.recv_str()  # jobid
+        assert fs.recv_str() == "start"
+        fs.send_int(0)   # rank
+        fs.send_int(-1)  # parent
+        fs.send_int(2)   # world_size
+        fs.send_int(0)   # n tree neighbors
+        fs.send_int(-1)  # ring prev
+        fs.send_int(-1)  # ring next
+        fs.recv_int()    # goodset size (0)
+        fs.send_int(0)   # n_conn: nothing to dial out
+        fs.send_int(1)   # n_wait: one incoming link that never comes
+        fs.recv_int()    # n_err
+        fs.recv_int()    # my_port
+        conn.close()
+
+    t = threading.Thread(target=fake_tracker, daemon=True)
+    t.start()
+    monkeypatch.setenv("DMLC_LINK_WAIT_TIMEOUT", "0.3")
+    w = RabitWorker(
+        tracker_uri="127.0.0.1", tracker_port=srv.getsockname()[1]
+    )
+    with pytest.raises(RuntimeError, match="timed out after .* incoming"):
+        w.start()
+    srv.close()
+
+
+def test_worker_link_wait_identify_stall_times_out(monkeypatch):
+    """The deadline also covers a connector that never sends its rank
+    (stray probe / half-dead peer): recv on the accepted socket must not
+    block past the shared deadline."""
+    import socket as socket_mod
+    import threading
+
+    from dmlc_core_tpu.tracker.client import RabitWorker
+    from dmlc_core_tpu.tracker.protocol import MAGIC, FramedSocket
+
+    srv = socket_mod.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    worker_port = []
+
+    def fake_tracker():
+        conn, _ = srv.accept()
+        fs = FramedSocket(conn)
+        assert fs.recv_int() == MAGIC
+        fs.send_int(MAGIC)
+        fs.recv_int(); fs.recv_int(); fs.recv_str(); fs.recv_str()
+        fs.send_int(0); fs.send_int(-1); fs.send_int(2)
+        fs.send_int(0); fs.send_int(-1); fs.send_int(-1)
+        fs.recv_int()
+        fs.send_int(0)  # n_conn
+        fs.send_int(1)  # n_wait
+        fs.recv_int()   # n_err
+        worker_port.append(fs.recv_int())
+        # dial the worker's listener but never send the rank int
+        mute = socket_mod.create_connection(("127.0.0.1", worker_port[0]))
+        mute.recv(1)  # hold open until the worker gives up
+        mute.close()
+        conn.close()
+
+    threading.Thread(target=fake_tracker, daemon=True).start()
+    monkeypatch.setenv("DMLC_LINK_WAIT_TIMEOUT", "0.4")
+    w = RabitWorker(
+        tracker_uri="127.0.0.1", tracker_port=srv.getsockname()[1]
+    )
+    with pytest.raises(RuntimeError, match="timed out after"):
+        w.start()
+    srv.close()
+
+
+def test_non_rabit_command_aborts_instead_of_wedging(monkeypatch):
+    """A launched command that exits 0 without ever joining the
+    rendezvous must fail fast with a diagnosis, not hang the join
+    forever (the reference tracker wedges here, tracker.py:293-311)."""
+    import importlib
+
+    monkeypatch.setenv("DMLC_RENDEZVOUS_GRACE", "0.5")
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    with pytest.raises(RuntimeError, match="rendezvous never completed"):
+        submit_mod.main(
+            ["--cluster", "local", "--num-workers", "2",
+             "--host-ip", "127.0.0.1", "true"]
+        )
+
+
 def test_dry_run_does_not_block(capsys):
     """--dry-run prints launch commands and returns without a tracker."""
     import importlib
