@@ -938,6 +938,11 @@ def create(
     - default wraps the split in a read-ahead thread (reference io.cc:119-122)
     - ``type``: 'text' | 'recordio' | 'indexed_recordio'
     """
+    check(
+        num_parts >= 1 and 0 <= part_index < num_parts,
+        f"invalid shard ({part_index}, {num_parts}): need "
+        "0 <= part_index < num_parts (reference io.cc CHECK)",
+    )
     spec = URISpec(uri, part_index, num_parts)
     # per-dataset options ride the URI (reference-style sugar); explicit
     # keyword args win when both are given:

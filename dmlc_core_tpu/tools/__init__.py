@@ -152,8 +152,12 @@ def _pack_lines(src_uri: str, writer) -> int:
 
 def _cmd_rowrec(args) -> int:
     """Text dataset → rowrec .rec shards (+ optional count index) for
-    the fused RecordIO→HBM staging path."""
-    parser = create_parser(args.src, type=args.format, threaded=False)
+    the fused RecordIO→HBM staging path. ``--part/--num-parts`` convert
+    one record-aligned shard so a large dataset converts in parallel
+    (e.g. one part per dmlc-submit worker)."""
+    parser = create_parser(
+        args.src, args.part, args.num_parts, type=args.format, threaded=False
+    )
     try:
         with contextlib.ExitStack() as stack:
             dst = stack.enter_context(Stream.create(args.dst, "w"))
@@ -220,6 +224,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     choices=("auto", "libsvm", "csv", "libfm"))
     rr.add_argument("--index", default="",
                     help="also write a count index")
+    rr.add_argument("--part", default=0, type=int,
+                    help="convert only this shard of src")
+    rr.add_argument("--num-parts", default=1, type=int)
     rr.set_defaults(fn=_cmd_rowrec)
     return p
 

@@ -122,6 +122,24 @@ def test_rowrec_conversion_feeds_staging(libsvm_file, tmp_path, capsys):
     assert n == 40
 
 
+def test_rowrec_sharded_conversion_covers_exactly(libsvm_file, tmp_path, capsys):
+    """--part/--num-parts converts record-aligned shards: the shard
+    .rec files together hold every row exactly once (parallel
+    conversion of large datasets, one part per worker)."""
+    labels = []
+    for part in range(3):
+        rec = str(tmp_path / f"s{part}.rec")
+        rc, _, err = run_cli(
+            ["rowrec", libsvm_file, rec, "--format", "libsvm",
+             "--part", str(part), "--num-parts", "3"],
+            capsys,
+        )
+        assert rc == 0
+        it = create_row_block_iter(rec + "?format=rowrec")
+        labels.extend(x for b in it for x in np.asarray(b.label).tolist())
+    assert sorted(labels) == sorted(float(i % 2) for i in range(40))
+
+
 def test_module_entrypoint_runs():
     proc = subprocess.run(
         [sys.executable, "-m", "dmlc_core_tpu.tools", "--help"],
@@ -134,3 +152,18 @@ def test_module_entrypoint_runs():
 def test_error_paths_return_nonzero(tmp_path, capsys):
     rc, _, err = run_cli(["cat", str(tmp_path / "missing.txt")], capsys)
     assert rc == 1 and "error:" in err
+
+
+def test_bad_shard_args_are_cli_errors(libsvm_file, tmp_path, capsys):
+    """Out-of-range --part/--num-parts must be a diagnosed CLI error
+    (shared factory check), not a traceback or a silent empty shard."""
+    rec = str(tmp_path / "x.rec")
+    for extra in (["--num-parts", "0"], ["--part", "3", "--num-parts", "3"],
+                  ["--part", "-1"]):
+        rc, _, err = run_cli(
+            ["rowrec", libsvm_file, rec, "--format", "libsvm", *extra],
+            capsys,
+        )
+        assert rc == 1 and "invalid shard" in err, (extra, err)
+    rc, _, err = run_cli(["split", libsvm_file, "2", "2"], capsys)
+    assert rc == 1 and "invalid shard" in err
