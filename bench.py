@@ -70,6 +70,9 @@ REC_K = REC_DENSE + REC_CAT
 REC_DATA = os.environ.get(
     "BENCH_REC_DATA", f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.rec"
 )
+LIBFM_DATA = os.environ.get(
+    "BENCH_LIBFM_DATA", f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.libfm"
+)
 
 
 def ensure_native() -> None:
@@ -141,6 +144,35 @@ def ensure_data() -> None:
                 lines.append(f"{labels[i]} {feats}\n")
             f.write("".join(lines))
     os.replace(tmp, DATA)
+
+
+def ensure_libfm_data() -> None:
+    """Criteo-like libfm text: 39 ``field:feat[:val]`` tokens per row
+    (13 dense fields with values, 26 categorical bare pairs) — the FM
+    ingestion analogue of the RecordIO shard (reference treats libfm as
+    a first-class hot path, libfm_parser.h:67-144)."""
+    if os.path.exists(LIBFM_DATA) and os.path.getsize(LIBFM_DATA) > 0:
+        return
+    rng = np.random.default_rng(11)
+    tmp = LIBFM_DATA + ".tmp"
+    with open(tmp, "w") as f:
+        chunk = 10000
+        for start in range(0, REC_ROWS, chunk):
+            n = min(chunk, REC_ROWS - start)
+            labels = rng.integers(0, 2, n)
+            dvals = rng.uniform(0, 1, (n, REC_DENSE))
+            cats = rng.integers(REC_DENSE, REC_SPACE, (n, REC_CAT))
+            lines = []
+            for i in range(n):
+                dense = " ".join(
+                    f"{j}:{j}:{dvals[i, j]:.6f}" for j in range(REC_DENSE)
+                )
+                cat = " ".join(
+                    f"{REC_DENSE + j}:{cats[i, j]}" for j in range(REC_CAT)
+                )
+                lines.append(f"{labels[i]} {dense} {cat}\n")
+            f.write("".join(lines))
+    os.replace(tmp, LIBFM_DATA)
 
 
 def ensure_rec_data() -> None:
@@ -289,6 +321,25 @@ def _make_rec_stream(value_dtype: str):
     )
 
 
+def _make_libfm_stream(value_dtype: str):
+    from dmlc_core_tpu.staging import BatchSpec, ell_batches
+
+    spec = BatchSpec(
+        batch_size=BATCH,
+        layout="ell",
+        max_nnz=REC_K,
+        value_dtype=np.dtype(value_dtype),
+    )
+    return (
+        ell_batches(
+            LIBFM_DATA + "?format=libfm", spec,
+            nthread=_nthread_for(REC_ROWS), ring=_RING,
+        ),
+        "values",
+        LIBFM_DATA,
+    )
+
+
 def run_epoch(make_stream, value_dtype: str) -> dict:
     """One full file → device epoch; returns rows/sec + MB/sec."""
     import jax
@@ -332,6 +383,7 @@ def main() -> None:
     ensure_data()
     ensure_rec_data()
     ensure_csv_data()
+    ensure_libfm_data()
     from dmlc_core_tpu.data import native
 
     # headline (f16) metrics first: the host↔device link on shared/tunneled
@@ -341,6 +393,7 @@ def main() -> None:
     rec_best = best_of(EPOCHS, _make_rec_stream, "float16")
     n32 = max(1, EPOCHS - 1)
     csv_best = best_of(n32, _make_csv_stream, "float16")
+    libfm_best = best_of(n32, _make_libfm_stream, "float16")
     f32 = round(best_of(n32, _make_higgs_stream, "float32")["rows_per_sec"], 1)
     rec_f32 = best_of(n32, _make_rec_stream, "float32")["rows_per_sec"]
     print(
@@ -361,10 +414,14 @@ def main() -> None:
                 "csv_staged_rows_per_sec": round(
                     csv_best["rows_per_sec"], 1
                 ),
+                "libfm_staged_rows_per_sec": round(
+                    libfm_best["rows_per_sec"], 1
+                ),
                 "native": native.AVAILABLE,
                 "fused_dense_kernel": native.HAS_DENSE,
                 "fused_ell_kernel": native.HAS_ELL,
                 "fused_csv_kernel": native.HAS_CSV_DENSE,
+                "fused_libfm_kernel": native.HAS_LIBFM_ELL,
                 "host_cpus": os.cpu_count(),
                 "parse_threads": _nthread_for(N_ROWS) or 1,
             }

@@ -27,12 +27,14 @@ __all__ = [
     "HAS_DENSE",
     "HAS_ELL",
     "HAS_CSV_DENSE",
+    "HAS_LIBFM_ELL",
     "parse_libsvm",
     "parse_csv",
     "parse_libfm",
     "parse_libsvm_dense",
     "parse_csv_dense",
     "parse_rowrec_ell",
+    "parse_libfm_ell",
     "source_hash",
     "load",
 ]
@@ -41,6 +43,7 @@ AVAILABLE = False
 HAS_DENSE = False      # fused libsvm->dense-batch kernel present in the .so
 HAS_ELL = False        # fused recordio rowrec->ELL-batch kernel present
 HAS_CSV_DENSE = False  # fused csv->dense-batch kernel present
+HAS_LIBFM_ELL = False  # fused libfm->ELL-batch kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -113,13 +116,14 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
     an in-session rebuild (the rebuilt file is a new inode, so dlopen
     returns a fresh handle; the old one is left to the process lifetime).
     """
-    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, _LIB
+    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_LIBFM_ELL, _LIB
     with _LOCK:
         if _LIB is not None and not force:
             return AVAILABLE
         if force:
             _LIB = None
             AVAILABLE = HAS_DENSE = HAS_ELL = HAS_CSV_DENSE = False
+            HAS_LIBFM_ELL = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES
@@ -171,6 +175,16 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
                     ctypes.POINTER(_EllResult)]
                 lib.dmlc_parse_rowrec_ell.restype = None
                 HAS_ELL = True
+            # fused libfm->ELL kernel: absent in older builds
+            if hasattr(lib, "dmlc_parse_libfm_ell"):
+                lib.dmlc_parse_libfm_ell.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.POINTER(_DenseResult)]
+                lib.dmlc_parse_libfm_ell.restype = None
+                HAS_LIBFM_ELL = True
             if hasattr(lib, "dmlc_source_hash"):
                 lib.dmlc_source_hash.restype = ctypes.c_char_p
                 lib.dmlc_source_hash.argtypes = []
@@ -391,23 +405,8 @@ def parse_rowrec_ell(
     """
     if not HAS_ELL:
         return None
-    from ..utils.logging import check
-
     mem = np.frombuffer(chunk, dtype=np.uint8)
-    check(indices.flags.c_contiguous and indices.dtype == np.int32,
-          "indices must be C-contiguous int32")
-    check(values.flags.c_contiguous
-          and values.dtype in (np.float32, np.float16),
-          "values must be C-contiguous float32/float16")
-    check(nnz.flags.c_contiguous and nnz.dtype == np.int32,
-          "nnz must be C-contiguous int32")
-    check(labels.flags.c_contiguous and labels.dtype == np.float32
-          and weights.flags.c_contiguous and weights.dtype == np.float32,
-          "labels/weights must be C-contiguous float32")
-    capacity, K = indices.shape
-    check(values.shape == (capacity, K), "values shape != indices shape")
-    check(len(nnz) >= capacity and len(labels) >= capacity
-          and len(weights) >= capacity, "1-D buffers shorter than capacity")
+    capacity, K = _check_ell_buffers(indices, values, nnz, labels, weights)
     res = _EllResult()
     _LIB.dmlc_parse_rowrec_ell(
         ctypes.c_void_p(mem.ctypes.data + offset),
@@ -424,6 +423,69 @@ def parse_rowrec_ell(
         ctypes.byref(res),
     )
     return res.rows_written, res.bytes_consumed, res.truncated, res.bad_records
+
+
+def _check_ell_buffers(indices, values, nnz, labels, weights):
+    """Shared memory-safety preconditions for the ELL-output kernels."""
+    from ..utils.logging import check
+
+    check(indices.flags.c_contiguous and indices.dtype == np.int32,
+          "indices must be C-contiguous int32")
+    check(values.flags.c_contiguous
+          and values.dtype in (np.float32, np.float16),
+          "values must be C-contiguous float32/float16")
+    check(nnz.flags.c_contiguous and nnz.dtype == np.int32,
+          "nnz must be C-contiguous int32")
+    check(labels.flags.c_contiguous and labels.dtype == np.float32
+          and weights.flags.c_contiguous and weights.dtype == np.float32,
+          "labels/weights must be C-contiguous float32")
+    capacity, K = indices.shape
+    check(values.shape == (capacity, K), "values shape != indices shape")
+    check(len(nnz) >= capacity and len(labels) >= capacity
+          and len(weights) >= capacity, "1-D buffers shorter than capacity")
+    return capacity, K
+
+
+def parse_libfm_ell(
+    chunk,
+    offset: int,
+    base: int,
+    indices: np.ndarray,
+    values: np.ndarray,
+    nnz: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+    cr_hint: int = -1,
+) -> Optional[Tuple[int, int, int, int]]:
+    """Fused libfm text parse → ELL batch rows (buffer contract of
+    ``parse_rowrec_ell``, resumable-chunk contract of
+    ``parse_libsvm_dense``). ``base`` is the resolved indexing base —
+    callers resolve libfm auto mode against the file head. Returns
+    (rows_written, bytes_consumed, truncated, has_cr), or None if the
+    kernel is missing."""
+    if not HAS_LIBFM_ELL:
+        return None
+    mem = np.frombuffer(chunk, dtype=np.uint8)
+    capacity, K = _check_ell_buffers(indices, values, nnz, labels, weights)
+    res = _DenseResult()
+    _LIB.dmlc_parse_libfm_ell(
+        ctypes.c_void_p(mem.ctypes.data + offset),
+        ctypes.c_int64(mem.size - offset),
+        ctypes.c_int32(base),
+        ctypes.c_int64(K),
+        ctypes.c_int32(1 if values.dtype == np.float16 else 0),
+        ctypes.c_void_p(indices.ctypes.data),
+        ctypes.c_void_p(values.ctypes.data),
+        ctypes.c_void_p(nnz.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.c_int32(cr_hint),
+        ctypes.byref(res),
+    )
+    return res.rows_written, res.bytes_consumed, res.truncated, res.has_cr
 
 
 load()

@@ -9,6 +9,7 @@ from .batcher import Batch, BatchSpec, FixedShapeBatcher
 from .fused import (
     FusedDenseCSVBatches,
     FusedDenseLibSVMBatches,
+    FusedEllLibFMBatches,
     FusedEllRowRecBatches,
     ShardedFusedBatches,
     dense_batches,
@@ -22,6 +23,7 @@ __all__ = [
     "FixedShapeBatcher",
     "FusedDenseCSVBatches",
     "FusedDenseLibSVMBatches",
+    "FusedEllLibFMBatches",
     "FusedEllRowRecBatches",
     "ShardedFusedBatches",
     "StagingPipeline",

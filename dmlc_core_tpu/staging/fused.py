@@ -131,25 +131,29 @@ class _MmapChunks:
 from functools import lru_cache
 
 
-@lru_cache(maxsize=64)
-def _probe_base_from_uri(uri: str) -> int:
-    """Resolve libsvm auto indexing from the head of the FIRST file.
+def _read_uri_head(uri: str, nbytes: int = 262144) -> bytes:
+    """Head of the FIRST file of a (possibly multi-file) URI.
 
-    Probing at offset 0 (not at this shard's own first chunk) keeps the
-    resolved base identical across all (part_index, num_parts) shards —
-    different shards must never disagree and silently shift feature
-    columns against each other. Cached per URI: a threaded fan-out
-    constructs one producer per sub-shard and must not re-read (possibly
-    remote) file heads per thread.
+    Probing at offset 0 (not at a shard's own first chunk) keeps any
+    head-resolved setting identical across all (part_index, num_parts)
+    shards — different shards must never disagree and silently shift
+    feature columns against each other.
     """
     fs = FileSystem.get_instance(uri.split(";")[0])
     first = io_split._expand_uris(fs, uri)[0]
     stream = fs.open(first, "r")
     try:
-        head = stream.read(262144)
+        return stream.read(nbytes)
     finally:
         stream.close()
-    return _probe_base(head)
+
+
+@lru_cache(maxsize=64)
+def _probe_base_from_uri(uri: str) -> int:
+    """Resolve libsvm auto indexing from the file head. Cached per URI: a
+    threaded fan-out constructs one producer per sub-shard and must not
+    re-read (possibly remote) file heads per thread."""
+    return _probe_base(_read_uri_head(uri))
 
 
 def _probe_base(chunk) -> int:
@@ -177,15 +181,16 @@ def _probe_base(chunk) -> int:
     return 1 if (min_idx is not None and min_idx > 0) else 0
 
 
-class _FusedDenseTextBatches:
-    """Shared machinery for fused text → dense-batch producers.
+class _FusedTextBatches:
+    """Shared machinery for fused text → fixed-shape-batch producers.
 
     Yields Batch views into a ring of ``ring`` preallocated buffer sets
-    (each one contiguous buffer: x | labels | weights views, so the
-    staging pipeline can issue a single DMA per batch); a yielded batch
-    stays valid until ``ring_slots - 1`` further batches have been
-    produced. Subclasses implement ``_parse`` (one resumable native call)
-    and optionally ``_first_chunk``.
+    (each one contiguous buffer, so the staging pipeline can issue a
+    single DMA per batch); a yielded batch stays valid until
+    ``ring_slots - 1`` further batches have been produced. Subclasses
+    implement the slot layout (``_alloc_slot``/``_emit``/``_pad_tail``)
+    and ``_parse`` (one resumable native call), and optionally
+    ``_first_chunk``.
     """
 
     def __init__(
@@ -196,7 +201,6 @@ class _FusedDenseTextBatches:
         num_parts: int = 1,
         ring: int = 8,
     ) -> None:
-        check(spec.layout == "dense", "fused path requires layout='dense'")
         check(spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16)),
               f"fused path supports f32/f16 values, not {spec.value_dtype}")
         self.spec = spec
@@ -206,17 +210,9 @@ class _FusedDenseTextBatches:
         # an open mmap/fd
         self._split_args = (part_index, num_parts)
         self._split = None
-        B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        self._ring: List[Tuple[np.ndarray, ...]] = []
-        for _ in range(max(2, ring)):
-            buf, v = _alloc_packed_slot(
-                [
-                    ("x", (B, D), spec.value_dtype),
-                    ("labels", (B,), np.float32),
-                    ("weights", (B,), np.float32),
-                ]
-            )
-            self._ring.append((v["x"], v["labels"], v["weights"], buf))
+        self._ring: List[Tuple[np.ndarray, ...]] = [
+            self._alloc_slot() for _ in range(max(2, ring))
+        ]
         self.ring_slots = len(self._ring)
         self._slot = 0
         self.rows_in = 0
@@ -224,28 +220,29 @@ class _FusedDenseTextBatches:
         self.truncated_nnz = 0
 
     # -- subclass hooks ------------------------------------------------------
+    def _alloc_slot(self) -> Tuple[np.ndarray, ...]:
+        """One ring slot: views into a packed buffer, packed buffer last."""
+        raise NotImplementedError
+
     def _first_chunk(self, chunk, off: int) -> int:
         """Inspect the first chunk (BOM, format probes); returns new off."""
         if bytes(memoryview(chunk)[:3]) == _BOM:
             off += 3  # UTF-8 BOM skip (text_parser.h:81-95)
         return off
 
-    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+    def _parse(self, chunk, off, slot, fill, cr_hint):
         """One resumable native call → (rows, consumed, cr_hint), updating
         truncation/error counters on self."""
         raise NotImplementedError
 
-    # -- shared loop ---------------------------------------------------------
-    def _emit(self, x, labels, weights, packed, n_valid: int) -> Batch:
-        self.rows_out += n_valid
-        if self.spec.overflow == "error" and self.truncated_nnz:
-            raise Error(
-                f"{self.truncated_nnz} features outside [0, "
-                f"{self.spec.num_features}) with overflow='error'"
-            )
-        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x,
-                     packed=packed)
+    def _emit(self, slot, n_valid: int) -> Batch:
+        raise NotImplementedError
 
+    def _pad_tail(self, slot, fill: int) -> None:
+        """Zero the padding rows of a final partial batch."""
+        raise NotImplementedError
+
+    # -- shared loop ---------------------------------------------------------
     def _ensure_split(self):
         if self._split is None:
             part_index, num_parts = self._split_args
@@ -264,7 +261,7 @@ class _FusedDenseTextBatches:
     def __iter__(self) -> Iterator[Batch]:
         split = self._ensure_split()
         B = self.spec.batch_size
-        x, labels, weights, packed = self._ring[self._slot]
+        slot = self._ring[self._slot]
         fill = 0
         first = True
         while True:
@@ -279,7 +276,7 @@ class _FusedDenseTextBatches:
             cr_hint = -1  # probe once per chunk, cache across resumed calls
             while off < n:
                 rows, consumed, cr_hint = self._parse(
-                    chunk, off, x, labels, weights, fill, cr_hint
+                    chunk, off, slot, fill, cr_hint
                 )
                 if consumed == 0 and rows == 0:
                     break  # defensive: no forward progress
@@ -287,21 +284,57 @@ class _FusedDenseTextBatches:
                 fill += rows
                 self.rows_in += rows
                 if fill == B:
-                    yield self._emit(x, labels, weights, packed, B)
+                    yield self._emit(slot, B)
                     self._slot = (self._slot + 1) % len(self._ring)
-                    x, labels, weights, packed = self._ring[self._slot]
+                    slot = self._ring[self._slot]
                     fill = 0
         if fill:
             # zero-pad the tail batch; padding rows carry weight 0
-            x[fill:] = 0
-            labels[fill:] = 0
-            weights[fill:] = 0
-            yield self._emit(x, labels, weights, packed, fill)
+            self._pad_tail(slot, fill)
+            yield self._emit(slot, fill)
             self._slot = (self._slot + 1) % len(self._ring)
 
     def close(self) -> None:
         if self._split is not None:
             self._split.close()
+
+
+class _FusedDenseTextBatches(_FusedTextBatches):
+    """Dense-slot specialization: ring slots are (x, labels, weights,
+    packed) views over one contiguous buffer per slot."""
+
+    def __init__(self, uri, spec, part_index=0, num_parts=1, ring=8):
+        check(spec.layout == "dense", "fused path requires layout='dense'")
+        super().__init__(uri, spec, part_index, num_parts, ring)
+
+    def _alloc_slot(self):
+        spec = self.spec
+        B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
+        buf, v = _alloc_packed_slot(
+            [
+                ("x", (B, D), spec.value_dtype),
+                ("labels", (B,), np.float32),
+                ("weights", (B,), np.float32),
+            ]
+        )
+        return (v["x"], v["labels"], v["weights"], buf)
+
+    def _emit(self, slot, n_valid: int) -> Batch:
+        x, labels, weights, packed = slot
+        self.rows_out += n_valid
+        if self.spec.overflow == "error" and self.truncated_nnz:
+            raise Error(
+                f"{self.truncated_nnz} features outside [0, "
+                f"{self.spec.num_features}) with overflow='error'"
+            )
+        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x,
+                     packed=packed)
+
+    def _pad_tail(self, slot, fill: int) -> None:
+        x, labels, weights, _packed = slot
+        x[fill:] = 0
+        labels[fill:] = 0
+        weights[fill:] = 0
 
 
 class FusedDenseLibSVMBatches(_FusedDenseTextBatches):
@@ -337,7 +370,8 @@ class FusedDenseLibSVMBatches(_FusedDenseTextBatches):
             self._base = _probe_base(chunk)
         return off
 
-    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+    def _parse(self, chunk, off, slot, fill, cr_hint):
+        x, labels, weights, _packed = slot
         rows, consumed, trunc, cr_hint = native.parse_libsvm_dense(
             chunk, off, self._base or 0, x, labels, weights, fill, cr_hint
         )
@@ -385,7 +419,8 @@ class FusedDenseCSVBatches(_FusedDenseTextBatches):
         self._delim = ord(delim)
         self.bad_lines = 0
 
-    def _parse(self, chunk, off, x, labels, weights, fill, cr_hint):
+    def _parse(self, chunk, off, slot, fill, cr_hint):
+        x, labels, weights, _packed = slot
         rows, consumed, trunc, cr_hint, bad = native.parse_csv_dense(
             chunk, off, self._delim, self._label_col, self._weight_col,
             x, labels, weights, fill, cr_hint,
@@ -721,6 +756,120 @@ class ShardedFusedBatches:
             p.close()
 
 
+@lru_cache(maxsize=64)
+def _probe_libfm_base_from_uri(uri: str) -> int:
+    """Resolve libfm auto indexing from the file head (same caching and
+    shard-consistency rationale as ``_probe_base_from_uri``)."""
+    return _probe_libfm_base(_read_uri_head(uri))
+
+
+def _probe_libfm_base(chunk) -> int:
+    """libfm auto indexing from a head sample: 1-based iff every field id
+    AND feature id seen is > 0 (the native CSR parser's auto rule,
+    native/fastparse.cc dmlc_parse_libfm; reference
+    libfm_parser.h:67-144 requires both)."""
+    head = bytes(memoryview(chunk)[:262144])
+    seen = False
+    for line in head.splitlines()[:2000]:
+        for tok in line.split()[1:]:
+            parts = tok.split(b":")
+            if len(parts) < 2:
+                continue
+            try:
+                fid, feat = int(parts[0]), int(parts[1])
+            except ValueError:
+                continue
+            if fid <= 0 or feat <= 0:  # native auto rule: min of BOTH > 0
+                return 0
+            seen = True
+    return 1 if seen else 0
+
+
+class FusedEllLibFMBatches(_FusedTextBatches):
+    """libfm text → ELL [B,K] via dmlc_parse_libfm_ell.
+
+    Semantics match LibFMParser + FixedShapeBatcher('ell') composed
+    (reference libfm_parser.h:67-144 tolerant tokenization; fields are
+    validated then dropped — the ELL device layout carries no field
+    axis, exactly like the generic batcher). ``indexing_mode`` rides the
+    constructor or ``?indexing_mode=`` on the URI; auto (-1) resolves
+    ONCE against the file head so shards can never disagree.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        indexing_mode: int = 0,
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_LIBFM_ELL, "native fused libfm kernel not loaded")
+        check(spec.layout == "ell", "fused libfm path requires layout='ell'")
+        check(spec.index_dtype == np.dtype(np.int32),
+              "fused ELL path stages int32 indices")
+        super().__init__(uri, spec, part_index, num_parts, ring)
+        if "indexing_mode" in self.uspec.args:
+            indexing_mode = int(self.uspec.args["indexing_mode"])
+        if indexing_mode < 0 and num_parts > 1:
+            indexing_mode = _probe_libfm_base_from_uri(self.uspec.uri)
+        self._base: Optional[int] = (
+            None if indexing_mode < 0 else (1 if indexing_mode > 0 else 0)
+        )
+
+    def _first_chunk(self, chunk, off: int) -> int:
+        off = super()._first_chunk(chunk, off)
+        if self._base is None:
+            self._base = _probe_libfm_base(chunk)
+        return off
+
+    def _alloc_slot(self):
+        spec = self.spec
+        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
+        buf, v = _alloc_packed_slot(
+            [
+                ("indices", (B, K), np.int32),
+                ("values", (B, K), spec.value_dtype),
+                ("nnz", (B,), np.int32),
+                ("labels", (B,), np.float32),
+                ("weights", (B,), np.float32),
+            ]
+        )
+        return (v["indices"], v["values"], v["nnz"], v["labels"],
+                v["weights"], buf)
+
+    def _parse(self, chunk, off, slot, fill, cr_hint):
+        indices, values, nnz, labels, weights, _packed = slot
+        rows, consumed, trunc, cr_hint = native.parse_libfm_ell(
+            chunk, off, self._base or 0, indices, values, nnz, labels,
+            weights, fill, cr_hint,
+        )
+        self.truncated_nnz += trunc
+        return rows, consumed, cr_hint
+
+    def _emit(self, slot, n_valid: int) -> Batch:
+        indices, values, nnz, labels, weights, packed = slot
+        self.rows_out += n_valid
+        if self.spec.overflow == "error" and self.truncated_nnz:
+            raise Error(
+                f"{self.truncated_nnz} features beyond max_nnz="
+                f"{self.spec.max_nnz} with overflow='error'"
+            )
+        return Batch(
+            labels=labels, weights=weights, n_valid=n_valid,
+            indices=indices, values=values, nnz=nnz, packed=packed,
+        )
+
+    def _pad_tail(self, slot, fill: int) -> None:
+        indices, values, nnz, labels, weights, _packed = slot
+        indices[fill:] = 0
+        values[fill:] = 0
+        nnz[fill:] = 0
+        labels[fill:] = 0
+        weights[fill:] = 0
+
+
 def ell_batches(
     uri: str,
     spec: BatchSpec,
@@ -728,22 +877,51 @@ def ell_batches(
     num_parts: int = 1,
     ring: int = 8,
     nthread: Optional[int] = None,
+    format: str = "auto",
 ):
-    """Best-available ELL Batch stream for a rowrec RecordIO URI.
+    """Best-available ELL Batch stream for a rowrec RecordIO URI or a
+    libfm text URI.
 
-    Uses the fused native kernel when loaded, otherwise the generic
-    RowRecParser → FixedShapeBatcher path with the same semantics. Either
-    way the result is iterable and has ``.close()``. ``nthread`` > 1 fans
-    the fused parse out over threads (ShardedFusedBatches: interleaved
-    sub-shard order, one padded tail per sub-shard).
+    ``format``: 'rowrec' | 'libfm' | 'auto' (``?format=`` from the URI,
+    defaulting to rowrec). Uses the fused native kernel when loaded,
+    otherwise the generic parser → FixedShapeBatcher path with the same
+    semantics. Either way the result is iterable and has ``.close()``.
+    ``nthread`` > 1 fans the fused parse out over threads
+    (ShardedFusedBatches: interleaved sub-shard order, one padded tail
+    per sub-shard).
     """
-    if (
-        native.HAS_ELL
-        and spec.layout == "ell"
+    uspec = URISpec(uri, part_index, num_parts)
+    if format == "auto":
+        format = str(uspec.args.get("format", "rowrec"))
+    check(format in ("rowrec", "libfm"),
+          f"ell_batches supports rowrec/libfm, not {format!r}")
+    fusable = (
+        spec.layout == "ell"
         and spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16))
         and spec.index_dtype == np.dtype(np.int32)
         and spec.overflow == "truncate"
-    ):
+    )
+    if format == "libfm":
+        if native.HAS_LIBFM_ELL and fusable:
+            if nthread is not None and nthread > 1:
+                return ShardedFusedBatches(
+                    lambda t, n: FusedEllLibFMBatches(
+                        uri, spec, part_index * n + t, num_parts * n,
+                        ring=ring,
+                    ),
+                    nthread,
+                )
+            return FusedEllLibFMBatches(
+                uri, spec, part_index, num_parts, ring=ring
+            )
+        from ..data import create_parser
+        from .batcher import FixedShapeBatcher
+
+        parser = create_parser(
+            uri, part_index, num_parts, type="libfm", nthread=nthread
+        )
+        return _GenericBatchStream(parser, FixedShapeBatcher(spec))
+    if native.HAS_ELL and fusable:
         if nthread is not None and nthread > 1:
             return ShardedFusedBatches(
                 lambda t, n: FusedEllRowRecBatches(

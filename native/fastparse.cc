@@ -1058,6 +1058,122 @@ DMLC_API void dmlc_parse_rowrec_ell(
   out->bad_records = bad;
 }
 
+// -- fused libfm -> fixed-shape ELL batch -------------------------------------
+//
+// Same resumable text-chunk contract as dmlc_parse_libsvm_dense (line walk,
+// cr_hint caching, stop at buffer-full/chunk-end) but ELL output; semantics
+// match dmlc_parse_libfm + FixedShapeBatcher('ell') composed (parity
+// enforced by tests/test_libfm_ell.py):
+//   - a line is skipped iff its label token fails to parse
+//     (label or label:weight first token);
+//   - feature tokens are field:index[:value]; tokens without a ':' or with
+//     malformed numbers are skipped (reference libfm_parser.h:67-144
+//     tolerant tokenization);
+//   - the first max_nnz parsed features keep their token positions; ids
+//     that fall outside int32 after base subtraction (incl. 1-based
+//     wraparound of id 0) are zeroed in place and counted truncated;
+//     features beyond max_nnz are dropped and counted;
+//   - fields are parsed (a malformed field skips the token) and then
+//     DROPPED: the ELL device layout carries no field axis, exactly like
+//     the generic batcher path (staging/batcher.py _to_ell).
+// `base` is the resolved indexing base (callers resolve libfm auto mode
+// against the file head, as the fused libsvm path does).
+
+DMLC_API void dmlc_parse_libfm_ell(
+    const char* buf, int64_t len, int32_t base, int64_t max_nnz,
+    int32_t out_f16, int32_t* indices, void* values, int32_t* nnz,
+    float* labels, float* weights, int64_t row_start, int64_t row_capacity,
+    int32_t cr_hint, DenseResult* out) {
+  EllState st{indices, values, nnz, labels, weights, max_nnz, out_f16 != 0, 0};
+  const uint64_t ubase = static_cast<uint64_t>(base);
+  const bool has_cr = walk_dense_lines(
+      buf, len, row_start, row_capacity, cr_hint, out,
+      [&](const char* lb, const char* le, int64_t row) {
+        // ---- label token: label or label:weight ----
+        const char* p = lb;
+        while (p < le && is_blank(*p)) ++p;
+        if (p >= le) return false;
+        const char* te = p;
+        while (te < le && !is_blank(*te)) ++te;
+        {
+          const char* colon = static_cast<const char*>(
+              memchr(p, ':', static_cast<size_t>(te - p)));
+          double lab, w = 1.0;
+          if (colon) {
+            if (!parse_float_full(p, colon, &lab) ||
+                !parse_float_full(colon + 1, te, &w))
+              return false;
+          } else if (!parse_float_full(p, te, &lab)) {
+            return false;
+          }
+          st.labels[row] = static_cast<float>(lab);
+          st.weights[row] = static_cast<float>(w);
+        }
+        p = te;
+
+        int32_t* irow = st.indices + row * st.K;
+        uint16_t* vrow16 =
+            st.f16 ? static_cast<uint16_t*>(st.values) + row * st.K : nullptr;
+        float* vrow32 =
+            st.f16 ? nullptr : static_cast<float*>(st.values) + row * st.K;
+        int64_t k = 0;    // parsed-feature position within the row
+        int64_t kept = 0; // features stored with a valid id
+        while (p < le) {
+          while (p < le && is_blank(*p)) ++p;
+          if (p >= le) break;
+          te = p;
+          while (te < le && !is_blank(*te)) ++te;
+          const char* c1 = static_cast<const char*>(
+              memchr(p, ':', static_cast<size_t>(te - p)));
+          if (c1) {
+            const char* c2 = static_cast<const char*>(
+                memchr(c1 + 1, ':', static_cast<size_t>(te - c1 - 1)));
+            int64_t fid, feat;
+            double v = 1.0;
+            bool ok = parse_i64_full(p, c1, &fid);
+            if (ok) {
+              ok = c2 ? (parse_i64_full(c1 + 1, c2, &feat) &&
+                         parse_float_full(c2 + 1, te, &v))
+                      : parse_i64_full(c1 + 1, te, &feat);
+            }
+            if (ok) {
+              if (k < st.K) {
+                const uint64_t col = static_cast<uint64_t>(feat) - ubase;
+                if (col > 0x7fffffffu) {
+                  irow[k] = 0;
+                  if (st.f16) vrow16[k] = 0; else vrow32[k] = 0.0f;
+                  ++st.truncated;
+                } else {
+                  irow[k] = static_cast<int32_t>(col);
+                  if (st.f16) vrow16[k] = f32_to_f16(static_cast<float>(v));
+                  else vrow32[k] = static_cast<float>(v);
+                  ++kept;
+                }
+              } else {
+                ++st.truncated;
+              }
+              ++k;
+            }
+          }
+          p = te;
+        }
+        const int64_t filled = k < st.K ? k : st.K;
+        std::memset(irow + filled, 0,
+                    static_cast<size_t>(st.K - filled) * 4);
+        if (st.f16) {
+          std::memset(vrow16 + filled, 0,
+                      static_cast<size_t>(st.K - filled) * 2);
+        } else {
+          std::memset(vrow32 + filled, 0,
+                      static_cast<size_t>(st.K - filled) * 4);
+        }
+        st.nnz[row] = static_cast<int32_t>(kept);
+        return true;
+      });
+  out->truncated = st.truncated;
+  out->has_cr = has_cr ? 1 : 0;
+}
+
 // Build stamp: the Makefile passes -DDMLC_SRC_HASH="sha256 of fastparse.cc"
 // so callers (bench.py ensure_native) can detect a stale prebuilt .so after
 // a failed rebuild instead of silently benchmarking last round's binary.
