@@ -434,7 +434,50 @@ class FusedDenseCSVBatches(_FusedDenseTextBatches):
         return rows, consumed, cr_hint
 
 
-class FusedEllRowRecBatches:
+class _EllSlotMixin:
+    """Shared ELL ring-slot layout for the fused ELL producers: each slot
+    is (indices, values, nnz, labels, weights, packed) views over ONE
+    contiguous buffer → one DMA per staged batch. Classes using it carry
+    ``spec``, ``rows_out`` and ``truncated_nnz``."""
+
+    def _alloc_ell_slot(self):
+        spec = self.spec
+        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
+        buf, v = _alloc_packed_slot(
+            [
+                ("indices", (B, K), np.int32),
+                ("values", (B, K), spec.value_dtype),
+                ("nnz", (B,), np.int32),
+                ("labels", (B,), np.float32),
+                ("weights", (B,), np.float32),
+            ]
+        )
+        return (v["indices"], v["values"], v["nnz"], v["labels"],
+                v["weights"], buf)
+
+    def _emit_ell(self, slot, n_valid: int) -> Batch:
+        indices, values, nnz, labels, weights, packed = slot
+        self.rows_out += n_valid
+        if self.spec.overflow == "error" and self.truncated_nnz:
+            raise Error(
+                f"{self.truncated_nnz} features beyond max_nnz="
+                f"{self.spec.max_nnz} with overflow='error'"
+            )
+        return Batch(
+            labels=labels, weights=weights, n_valid=n_valid,
+            indices=indices, values=values, nnz=nnz, packed=packed,
+        )
+
+    def _pad_ell_tail(self, slot, fill: int) -> None:
+        indices, values, nnz, labels, weights, _packed = slot
+        indices[fill:] = 0
+        values[fill:] = 0
+        nnz[fill:] = 0
+        labels[fill:] = 0
+        weights[fill:] = 0
+
+
+class FusedEllRowRecBatches(_EllSlotMixin):
     """Iterator of ELL Batches over a rowrec RecordIO URI via the fused
     native kernel (native/fastparse.cc dmlc_parse_rowrec_ell).
 
@@ -488,23 +531,9 @@ class FusedEllRowRecBatches:
                 part_index, num_parts, type="recordio",
             )
         )
-        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
-        # one contiguous buffer per slot → one DMA per staged batch
-        self._ring: List[Tuple[np.ndarray, ...]] = []
-        for _ in range(max(2, ring)):
-            buf, v = _alloc_packed_slot(
-                [
-                    ("indices", (B, K), np.int32),
-                    ("values", (B, K), spec.value_dtype),
-                    ("nnz", (B,), np.int32),
-                    ("labels", (B,), np.float32),
-                    ("weights", (B,), np.float32),
-                ]
-            )
-            self._ring.append(
-                (v["indices"], v["values"], v["nnz"], v["labels"],
-                 v["weights"], buf)
-            )
+        self._ring: List[Tuple[np.ndarray, ...]] = [
+            self._alloc_ell_slot() for _ in range(max(2, ring))
+        ]
         self.ring_slots = len(self._ring)
         self._slot = 0
         self.rows_in = 0
@@ -513,17 +542,7 @@ class FusedEllRowRecBatches:
         self.bad_records = 0
 
     def _emit(self, bufs, n_valid: int) -> Batch:
-        indices, values, nnz, labels, weights, packed = bufs
-        self.rows_out += n_valid
-        if self.spec.overflow == "error" and self.truncated_nnz:
-            raise Error(
-                f"{self.truncated_nnz} features beyond max_nnz="
-                f"{self.spec.max_nnz} with overflow='error'"
-            )
-        return Batch(
-            labels=labels, weights=weights, n_valid=n_valid,
-            indices=indices, values=values, nnz=nnz, packed=packed,
-        )
+        return self._emit_ell(bufs, n_valid)
 
     def _feed(self, chunk, off: int, fill: int):
         """Parse chunk[off:] into the current slot; returns updated
@@ -605,12 +624,7 @@ class FusedEllRowRecBatches:
 
     def _tail(self, fill: int) -> Iterator[Batch]:
         # zero-pad the final partial batch; padding rows carry weight 0
-        indices, values, nnz, labels, weights, _packed = self._ring[self._slot]
-        indices[fill:] = 0
-        values[fill:] = 0
-        nnz[fill:] = 0
-        labels[fill:] = 0
-        weights[fill:] = 0
+        self._pad_ell_tail(self._ring[self._slot], fill)
         yield self._emit(self._ring[self._slot], fill)
         self._slot = (self._slot + 1) % len(self._ring)
 
@@ -767,25 +781,26 @@ def _probe_libfm_base(chunk) -> int:
     """libfm auto indexing from a head sample: 1-based iff every field id
     AND feature id seen is > 0 (the native CSR parser's auto rule,
     native/fastparse.cc dmlc_parse_libfm; reference
-    libfm_parser.h:67-144 requires both)."""
+    libfm_parser.h:67-144 requires both). Tokens are accepted/rejected by
+    the same parse_triple rule the parsers use — a junk token the parsers
+    would skip must not decide the base."""
+    from ..data.strtonum import parse_triple
+
     head = bytes(memoryview(chunk)[:262144])
     seen = False
     for line in head.splitlines()[:2000]:
         for tok in line.split()[1:]:
-            parts = tok.split(b":")
-            if len(parts) < 2:
+            triple = parse_triple(tok)
+            if triple is None:
                 continue
-            try:
-                fid, feat = int(parts[0]), int(parts[1])
-            except ValueError:
-                continue
+            fid, feat, _v = triple
             if fid <= 0 or feat <= 0:  # native auto rule: min of BOTH > 0
                 return 0
             seen = True
     return 1 if seen else 0
 
 
-class FusedEllLibFMBatches(_FusedTextBatches):
+class FusedEllLibFMBatches(_EllSlotMixin, _FusedTextBatches):
     """libfm text → ELL [B,K] via dmlc_parse_libfm_ell.
 
     Semantics match LibFMParser + FixedShapeBatcher('ell') composed
@@ -825,19 +840,7 @@ class FusedEllLibFMBatches(_FusedTextBatches):
         return off
 
     def _alloc_slot(self):
-        spec = self.spec
-        B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
-        buf, v = _alloc_packed_slot(
-            [
-                ("indices", (B, K), np.int32),
-                ("values", (B, K), spec.value_dtype),
-                ("nnz", (B,), np.int32),
-                ("labels", (B,), np.float32),
-                ("weights", (B,), np.float32),
-            ]
-        )
-        return (v["indices"], v["values"], v["nnz"], v["labels"],
-                v["weights"], buf)
+        return self._alloc_ell_slot()
 
     def _parse(self, chunk, off, slot, fill, cr_hint):
         indices, values, nnz, labels, weights, _packed = slot
@@ -849,25 +852,10 @@ class FusedEllLibFMBatches(_FusedTextBatches):
         return rows, consumed, cr_hint
 
     def _emit(self, slot, n_valid: int) -> Batch:
-        indices, values, nnz, labels, weights, packed = slot
-        self.rows_out += n_valid
-        if self.spec.overflow == "error" and self.truncated_nnz:
-            raise Error(
-                f"{self.truncated_nnz} features beyond max_nnz="
-                f"{self.spec.max_nnz} with overflow='error'"
-            )
-        return Batch(
-            labels=labels, weights=weights, n_valid=n_valid,
-            indices=indices, values=values, nnz=nnz, packed=packed,
-        )
+        return self._emit_ell(slot, n_valid)
 
     def _pad_tail(self, slot, fill: int) -> None:
-        indices, values, nnz, labels, weights, _packed = slot
-        indices[fill:] = 0
-        values[fill:] = 0
-        nnz[fill:] = 0
-        labels[fill:] = 0
-        weights[fill:] = 0
+        self._pad_ell_tail(slot, fill)
 
 
 def ell_batches(
@@ -878,14 +866,17 @@ def ell_batches(
     ring: int = 8,
     nthread: Optional[int] = None,
     format: str = "auto",
+    indexing_mode: int = 0,
 ):
     """Best-available ELL Batch stream for a rowrec RecordIO URI or a
     libfm text URI.
 
     ``format``: 'rowrec' | 'libfm' | 'auto' (``?format=`` from the URI,
-    defaulting to rowrec). Uses the fused native kernel when loaded,
-    otherwise the generic parser → FixedShapeBatcher path with the same
-    semantics. Either way the result is iterable and has ``.close()``.
+    defaulting to rowrec). ``indexing_mode`` applies to the libfm path
+    (same contract as ``dense_batches``; ``?indexing_mode=`` on the URI
+    wins). Uses the fused native kernel when loaded, otherwise the
+    generic parser → FixedShapeBatcher path with the same semantics.
+    Either way the result is iterable and has ``.close()``.
     ``nthread`` > 1 fans the fused parse out over threads
     (ShardedFusedBatches: interleaved sub-shard order, one padded tail
     per sub-shard).
@@ -907,16 +898,25 @@ def ell_batches(
                 return ShardedFusedBatches(
                     lambda t, n: FusedEllLibFMBatches(
                         uri, spec, part_index * n + t, num_parts * n,
-                        ring=ring,
+                        indexing_mode=indexing_mode, ring=ring,
                     ),
                     nthread,
                 )
             return FusedEllLibFMBatches(
-                uri, spec, part_index, num_parts, ring=ring
+                uri, spec, part_index, num_parts,
+                indexing_mode=indexing_mode, ring=ring,
             )
         from ..data import create_parser
         from .batcher import FixedShapeBatcher
 
+        if indexing_mode and "indexing_mode" not in uspec.args:
+            # parser params ride the URI (URI-provided values keep
+            # winning); insert before any #cachefile fragment
+            head, sep, frag = uri.partition("#")
+            head += ("&" if "?" in head else "?") + (
+                f"indexing_mode={indexing_mode}"
+            )
+            uri = head + sep + frag
         parser = create_parser(
             uri, part_index, num_parts, type="libfm", nthread=nthread
         )

@@ -170,6 +170,25 @@ def test_threaded_fan_out_covers(tmp_path):
     assert len(labels) == 300
 
 
+@fused
+def test_dispatcher_indexing_mode_kwarg(tmp_path):
+    """ell_batches(indexing_mode=1) matches the URI sugar on both the
+    fused path and the generic fallback (dense_batches API symmetry)."""
+    path = _write_libfm(str(tmp_path / "k.libfm"), rows=60, one_based=True)
+
+    def indices(**kw):
+        s = ell_batches(path + "?format=libfm", _spec(), **kw)
+        out = [b.indices.copy() for b in s]
+        s.close()
+        return np.concatenate(out)
+
+    via_kwarg = indices(indexing_mode=1)
+    s2 = ell_batches(path + "?format=libfm&indexing_mode=1", _spec())
+    via_uri = np.concatenate([b.indices.copy() for b in s2])
+    s2.close()
+    np.testing.assert_array_equal(via_kwarg, via_uri)
+
+
 def test_auto_probe_negative_ids_resolve_zero_based(tmp_path):
     """Negative ids in the head must resolve auto mode to 0-based (the
     native CSR rule is min of BOTH fields and features > 0), not shift
