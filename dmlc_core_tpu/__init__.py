@@ -33,3 +33,57 @@ via ctypes when available; every component has a pure-Python/numpy fallback.
 __version__ = "0.1.0"
 
 from . import utils  # noqa: F401
+
+
+def build_info() -> dict:
+    """Runtime feature report — the reference's compile-time base.h /
+    build_config_default.h feature macros (DMLC_USE_*, DMLC_LOG_*,
+    reference include/dmlc/base.h) become inspectable runtime facts on a
+    Python/JAX substrate: which native kernels loaded, which env flags
+    are active, and what the accelerator runtime looks like."""
+    import os
+
+    from .data import native
+
+    info = {
+        "version": __version__,
+        "native_available": native.AVAILABLE,
+        "native_source_hash": native.source_hash(),
+        "fused_kernels": {
+            "libsvm_dense": native.HAS_DENSE,
+            "csv_dense": native.HAS_CSV_DENSE,
+            "rowrec_ell": native.HAS_ELL,
+            "libfm_ell": native.HAS_LIBFM_ELL,
+        },
+        "env": {
+            k: os.environ[k]
+            for k in (
+                "DMLC_TPU_NO_NATIVE",
+                "DMLC_TPU_PARSER_THREADS",
+                "DMLC_LOG_DEBUG",
+                "DMLC_MAX_ATTEMPT",
+                "DMLC_RENDEZVOUS_GRACE",
+                "DMLC_LINK_WAIT_TIMEOUT",
+                "DMLC_YARN_REST",
+            )
+            if k in os.environ
+        },
+    }
+    try:  # jax is present on TPU hosts but must stay optional here
+        import jax
+    except ImportError:
+        info["jax"] = None
+        return info
+    info["jax"] = {"version": jax.__version__}
+    try:
+        # backend probes initialize (and on libtpu, CLAIM) the
+        # accelerator — a failure here (device busy, no backend) must
+        # read differently from jax-not-installed
+        info["jax"].update(
+            default_backend=jax.default_backend(),
+            device_count=jax.device_count(),
+            process_count=jax.process_count(),
+        )
+    except Exception as exc:
+        info["jax"]["backend_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return info

@@ -173,6 +173,17 @@ def _cmd_rowrec(args) -> int:
     return 0
 
 
+def _cmd_info(args) -> int:
+    """Runtime feature report (build_info): native kernels, env flags,
+    accelerator runtime — the base.h feature macros as runtime facts."""
+    import json
+
+    from .. import build_info
+
+    print(json.dumps(build_info(), indent=2))
+    return 0
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dmlc_core_tpu.tools",
@@ -228,6 +239,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="convert only this shard of src")
     rr.add_argument("--num-parts", default=1, type=int)
     rr.set_defaults(fn=_cmd_rowrec)
+
+    info = sub.add_parser("info", help="runtime feature report (JSON)")
+    info.set_defaults(fn=_cmd_info)
     return p
 
 

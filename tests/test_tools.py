@@ -154,6 +154,24 @@ def test_error_paths_return_nonzero(tmp_path, capsys):
     assert rc == 1 and "error:" in err
 
 
+def test_info_reports_features(capsys):
+    """`tools info` emits the build_info report: kernel flags present and
+    consistent with the loaded native module (base.h feature macros as
+    runtime facts, reference include/dmlc/base.h)."""
+    import json
+
+    from dmlc_core_tpu.data import native as native_mod
+
+    rc, out, _ = run_cli(["info"], capsys)
+    assert rc == 0
+    info = json.loads(out)
+    assert info["native_available"] == native_mod.AVAILABLE
+    assert info["fused_kernels"]["libfm_ell"] == native_mod.HAS_LIBFM_ELL
+    assert set(info["fused_kernels"]) == {
+        "libsvm_dense", "csv_dense", "rowrec_ell", "libfm_ell"
+    }
+
+
 def test_bad_shard_args_are_cli_errors(libsvm_file, tmp_path, capsys):
     """Out-of-range --part/--num-parts must be a diagnosed CLI error
     (shared factory check), not a traceback or a silent empty shard."""
