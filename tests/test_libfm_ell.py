@@ -201,6 +201,49 @@ def test_auto_probe_negative_ids_resolve_zero_based(tmp_path):
     assert _probe_libfm_base(b"1 0:3:1.0\n") == 0
 
 
+@fused
+def test_fuzz_parity(tmp_path):
+    """Randomized noisy libfm text stages identically through the fused
+    kernel and the generic path (the ELL analogue of
+    tests/test_native.py::test_fuzz_parity; runs under ASan via make
+    check — TSan is not relevant here: each fused producer owns its
+    buffers, threads never share a ring slot)."""
+    rng = np.random.default_rng(23)
+    junk_pool = ["x", "a:b", "1:2:3:4", ":", "::", "-:-", "7:", ":9",
+                 "1:2:nan", "1e3:4", "  "]
+    for trial in range(12):
+        lines = []
+        for _ in range(60):
+            toks = []
+            r = rng.random()
+            if r < 0.15:
+                toks.append("junklabel")  # line dropped by both paths
+            elif r < 0.4:
+                toks.append(f"{rng.normal():.4g}:{abs(rng.normal()):.3g}")
+            else:
+                toks.append(f"{rng.normal():.4g}")
+            for _ in range(int(rng.integers(0, 9))):
+                if rng.random() < 0.25:
+                    toks.append(str(rng.choice(junk_pool)))
+                else:
+                    fid = int(rng.integers(-2, 15))
+                    feat = int(rng.integers(-2, 3000))
+                    if rng.random() < 0.5:
+                        toks.append(f"{fid}:{feat}:{rng.normal():.5g}")
+                    else:
+                        toks.append(f"{fid}:{feat}")
+            lines.append(" ".join(toks))
+        eol = "\r\n" if trial % 3 == 0 else "\n"
+        path = str(tmp_path / f"fz{trial}.libfm")
+        with open(path, "w", newline="") as f:
+            f.write(eol.join(lines) + eol)
+        for dtype in ("float32", "float16"):
+            f_b, f_t = _fused(path, _spec(dtype, B=37, K=4))
+            g_b, g_t = _generic(path, _spec(dtype, B=37, K=4))
+            _assert_equal(f_b, g_b)
+            assert f_t == g_t, (trial, dtype)
+
+
 def test_generic_fallback_without_native(tmp_path, monkeypatch):
     """ell_batches format=libfm works (same totals) when the kernel is
     reported missing."""
