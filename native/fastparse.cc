@@ -133,6 +133,41 @@ inline bool parse_float_simple(const char* b, const char* e, double* out) {
   return true;
 }
 
+// Fused decimal-value scan shared by the text kernels' fast paths:
+// advances *pq past ``[-]digits[.digits]``; succeeds iff the value ends
+// at a token boundary and has <= 15 digits (larger magnitudes and
+// exponent forms go to the exact slow path, keeping values bit-identical
+// across paths). On failure the caller re-parses from the token start.
+inline bool scan_decimal_value(const char** pq, const char* le, double* out) {
+  const char* q = *pq;
+  bool neg = false;
+  if (q < le && *q == '-') {
+    neg = true;
+    ++q;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  bool dot = false, any = false;
+  for (; q < le; ++q) {
+    const char c = *q;
+    if (c >= '0' && c <= '9') {
+      if (++digits > 15) return false;
+      mant = mant * 10 + static_cast<uint64_t>(c - '0');
+      any = true;
+      if (dot) ++frac;
+    } else if (c == '.' && !dot) {
+      dot = true;
+    } else {
+      break;  // only a token boundary may follow
+    }
+  }
+  if (!any || (q < le && !is_blank(*q))) return false;
+  const double v = static_cast<double>(mant) / kPow10[frac];
+  *out = neg ? -v : v;
+  *pq = q;
+  return true;
+}
+
 // Full-token float parse (Python float() semantics: whole token or fail).
 // Out-of-range magnitudes resolve via strtod (±inf on overflow, 0 on
 // underflow), matching Python float("1e999") == inf.
@@ -302,34 +337,10 @@ DMLC_API ParseResult* dmlc_parse_libsvm(const char* buf, int64_t len,
         }
         if (*q == ':') {
           ++q;
-          bool neg = false;
-          if (q < le && *q == '-') {
-            neg = true;
-            ++q;
-          }
-          uint64_t mant = 0;
-          int digits = 0, frac = 0;
-          bool dot = false, fok = true, any = false;
-          for (; q < le; ++q) {
-            const char c = *q;
-            if (c >= '0' && c <= '9') {
-              if (++digits > 15) {
-                fok = false;
-                break;
-              }
-              mant = mant * 10 + static_cast<uint64_t>(c - '0');
-              any = true;
-              if (dot) ++frac;
-            } else if (c == '.' && !dot) {
-              dot = true;
-            } else {
-              break;  // fok stays true only if this is a token boundary
-            }
-          }
-          if (fok && any && (q >= le || is_blank(*q))) {
-            const double v = static_cast<double>(mant) / kPow10[frac];
+          double v;
+          if (scan_decimal_value(&q, le, &v)) {
             h->index.push_back(feat);
-            h->value.push_back(static_cast<float>(neg ? -v : v));
+            h->value.push_back(static_cast<float>(v));
             any_value = true;
             if (static_cast<int64_t>(feat) < min_feat)
               min_feat = static_cast<int64_t>(feat);
@@ -659,35 +670,11 @@ inline bool parse_dense_line(const char* lb, const char* le, DenseState& st,
       }
       if (*q == ':') {
         ++q;
-        bool neg = false;
-        if (q < le && *q == '-') {
-          neg = true;
-          ++q;
-        }
-        uint64_t mant = 0;
-        int digits = 0, frac = 0;
-        bool dot = false, fok = true, any = false;
-        for (; q < le; ++q) {
-          const char c = *q;
-          if (c >= '0' && c <= '9') {
-            if (++digits > 15) {
-              fok = false;
-              break;
-            }
-            mant = mant * 10 + static_cast<uint64_t>(c - '0');
-            any = true;
-            if (dot) ++frac;
-          } else if (c == '.' && !dot) {
-            dot = true;
-          } else {
-            break;
-          }
-        }
-        if (fok && any && (q >= le || is_blank(*q))) {
-          const double v = static_cast<double>(mant) / kPow10[frac];
+        double v;
+        if (scan_decimal_value(&q, le, &v)) {
           const uint64_t col = feat - ubase;
           if (col < uD) {
-            st.scratch[col] += static_cast<float>(neg ? -v : v);
+            st.scratch[col] += static_cast<float>(v);
           } else {
             ++st.truncated;
           }
@@ -1118,9 +1105,72 @@ DMLC_API void dmlc_parse_libfm_ell(
             st.f16 ? nullptr : static_cast<float*>(st.values) + row * st.K;
         int64_t k = 0;    // parsed-feature position within the row
         int64_t kept = 0; // features stored with a valid id
+        const auto store = [&](int64_t feat, double v) {
+          if (k < st.K) {
+            const uint64_t col = static_cast<uint64_t>(feat) - ubase;
+            if (col > 0x7fffffffu) {
+              irow[k] = 0;
+              if (st.f16) vrow16[k] = 0; else vrow32[k] = 0.0f;
+              ++st.truncated;
+            } else {
+              irow[k] = static_cast<int32_t>(col);
+              if (st.f16) vrow16[k] = f32_to_f16(static_cast<float>(v));
+              else vrow32[k] = static_cast<float>(v);
+              ++kept;
+            }
+          } else {
+            ++st.truncated;
+          }
+          ++k;
+        };
         while (p < le) {
           while (p < le && is_blank(*p)) ++p;
           if (p >= le) break;
+          // ---- fast path: fid ':' feat [':' value] in ONE forward pass
+          // (the same fused scan style as the libsvm dense kernel) ----
+          const char* q = p;
+          int fd = 0;
+          if (q < le && *q == '-') ++q;
+          while (q < le && *q >= '0' && *q <= '9' && fd <= 18) {
+            ++q;
+            ++fd;  // fid digits: validity only, the value is dropped
+          }
+          if (fd > 0 && fd <= 18 && q < le && *q == ':') {
+            ++q;
+            bool gneg = false;
+            if (q < le && *q == '-') {
+              gneg = true;
+              ++q;
+            }
+            uint64_t feat = 0;
+            int gd = 0;
+            while (q < le && *q >= '0' && *q <= '9' && gd <= 18) {
+              feat = feat * 10 + static_cast<uint64_t>(*q - '0');
+              ++q;
+              ++gd;
+            }
+            if (gd > 0 && gd <= 18) {
+              const int64_t sfeat =
+                  gneg ? -static_cast<int64_t>(feat)
+                       : static_cast<int64_t>(feat);
+              if (q >= le || is_blank(*q)) {
+                store(sfeat, 1.0);  // bare pair fid:feat
+                p = q;
+                continue;
+              }
+              if (*q == ':') {
+                ++q;
+                double v;
+                if (scan_decimal_value(&q, le, &v)) {
+                  store(sfeat, v);
+                  p = q;
+                  continue;
+                }
+              }
+            }
+          }
+          // ---- exact slow path over the full token (rare: exponents,
+          // '+' signs, >15-digit values, junk) ----
           te = p;
           while (te < le && !is_blank(*te)) ++te;
           const char* c1 = static_cast<const char*>(
@@ -1136,24 +1186,7 @@ DMLC_API void dmlc_parse_libfm_ell(
                          parse_float_full(c2 + 1, te, &v))
                       : parse_i64_full(c1 + 1, te, &feat);
             }
-            if (ok) {
-              if (k < st.K) {
-                const uint64_t col = static_cast<uint64_t>(feat) - ubase;
-                if (col > 0x7fffffffu) {
-                  irow[k] = 0;
-                  if (st.f16) vrow16[k] = 0; else vrow32[k] = 0.0f;
-                  ++st.truncated;
-                } else {
-                  irow[k] = static_cast<int32_t>(col);
-                  if (st.f16) vrow16[k] = f32_to_f16(static_cast<float>(v));
-                  else vrow32[k] = static_cast<float>(v);
-                  ++kept;
-                }
-              } else {
-                ++st.truncated;
-              }
-              ++k;
-            }
+            if (ok) store(feat, v);
           }
           p = te;
         }
