@@ -116,6 +116,19 @@ class RecordIOWriter:
         check(isinstance(self.stream, SeekStream), "stream is not seekable")
         return self.stream.tell()  # type: ignore[union-attr]
 
+    def write_framed_block(self, framed: bytes, offsets) -> None:
+        """Bulk-write pre-framed records (data/rowrec.py
+        encode_block_frames output). ``offsets`` are frame-start byte
+        offsets relative to ``framed``; subclasses use them to keep
+        per-record bookkeeping (the index sidecar) in one place."""
+        base = self.bytes_written
+        self.stream.write(framed)
+        self.bytes_written += len(framed)
+        self._note_framed_records(base, offsets)
+
+    def _note_framed_records(self, base: int, offsets) -> None:
+        pass  # the plain writer keeps no per-record state
+
 
 class IndexedRecordIOWriter(RecordIOWriter):
     """RecordIO writer that also emits the external index file an
@@ -140,6 +153,16 @@ class IndexedRecordIOWriter(RecordIOWriter):
         k = self._count if key is None else key
         self.index_stream.write(f"{k}\t{offset}\n".encode())
         self._count += 1
+
+    def _note_framed_records(self, base: int, offsets) -> None:
+        if len(offsets) == 0:
+            return
+        lines = "".join(
+            f"{self._count + i}\t{base + int(o)}\n"
+            for i, o in enumerate(offsets)
+        )
+        self.index_stream.write(lines.encode())
+        self._count += len(offsets)
 
 
 class RecordIOReader:

@@ -388,6 +388,63 @@ def test_shuffle_with_cachefile_refused(tmp_path):
         )
 
 
+def test_vectorized_framer_byte_identical():
+    """encode_block_frames output must be byte-for-byte what
+    RecordIOWriter emits for the same payloads, offsets included."""
+    from dmlc_core_tpu.data.rowrec import encode_block_frames, encode_rows
+
+    rng = np.random.default_rng(31)
+    blk = _random_block(rng, 300, max_nnz=9)
+    fast = encode_block_frames(blk)
+    assert fast is not None
+    framed, offsets = fast
+    ms = MemoryStream()
+    w = RecordIOWriter(ms)
+    slow_offsets = []
+    for payload in encode_rows(blk):
+        slow_offsets.append(w.bytes_written)
+        w.write_record(payload)
+    assert framed == ms.getvalue()
+    np.testing.assert_array_equal(offsets, slow_offsets)
+
+
+def test_vectorized_framer_collision_fallback():
+    """Blocks whose payloads contain the aligned magic word must decline
+    the fast path (the writer's multipart escape is required) — and
+    write_rowrec output stays correct either way."""
+    from dmlc_core_tpu.data.rowrec import encode_block_frames
+
+    rng = np.random.default_rng(32)
+    blk = _random_block(rng, 60, magic_every=7)
+    assert encode_block_frames(blk) is None
+    ms = MemoryStream()
+    assert write_rowrec(ms, [blk]) == 60
+    ms.seek(0)
+    out = decode_records(RecordIOReader(ms))
+    np.testing.assert_array_equal(out.value, blk.value)
+
+
+def test_vectorized_framer_sliced_block():
+    """RowBlock.slice rebases offsets and arrays (row_block.py slice
+    contract); framing a slice yields exactly those rows."""
+    from dmlc_core_tpu.data.rowrec import encode_block_frames
+
+    rng = np.random.default_rng(33)
+    blk = _random_block(rng, 100, max_nnz=5)
+    part = blk.slice(40, 80)
+    fast = encode_block_frames(part)
+    assert fast is not None
+    framed, _ = fast
+    ms = MemoryStream()
+    ms.write(framed)
+    ms.seek(0)
+    out = decode_records(RecordIOReader(ms))
+    np.testing.assert_array_equal(out.label, blk.label[40:80])
+    np.testing.assert_array_equal(
+        out.value, blk.value[blk.offset[40]:blk.offset[80]]
+    )
+
+
 def test_fused_ell_over_remote_uri():
     """The fused ELL producer must compose with non-local URIs (object
     stores) through the RecordIO splitter — the mmap fast path is a
