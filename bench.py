@@ -350,8 +350,11 @@ def run_epoch(make_stream, value_dtype: str) -> dict:
     # depth 3 measured ~3% over depth 2 steady-state on the tunneled
     # frontend (deeper in-flight window rides out link jitter); 4 was
     # equal at more HBM. Ring (8 slots) stays > prefetch+depth.
-    pipe = StagingPipeline(stream, depth=3)
+    # timer covers pipeline construction: its prefetch thread starts
+    # parsing immediately, so an after-construction t0 would let real
+    # staging work escape the measurement
     t0 = time.perf_counter()
+    pipe = StagingPipeline(stream, depth=3)
     last = None
     for dev in pipe:
         last = dev

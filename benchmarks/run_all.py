@@ -172,18 +172,26 @@ def bench_recordio_staged(tmp: str) -> None:
         batch_size=4096, layout="ell", max_nnz=k,
         value_dtype=np.dtype(np.float16),
     )
-    stream = ell_batches(path, spec)
-    pipe = StagingPipeline(stream, depth=2)
-    t0 = time.perf_counter()
-    for _ in pipe:
-        pass
-    dt = time.perf_counter() - t0
-    assert pipe.rows_staged == n
-    stream.close()
-    pipe.close()
-    RESULTS["recordio_staged_rows_per_sec"] = round(n / dt, 1)
+    # best of two epochs: the first pays XLA compilation + transfer
+    # warmup and grossly understates steady-state (bench.py best_of)
+    best = float("inf")
+    for _ in range(2):
+        stream = ell_batches(path, spec)
+        # timer covers pipeline construction: its prefetch thread starts
+        # parsing immediately, and at small scale that work could
+        # otherwise finish before an after-construction t0
+        t0 = time.perf_counter()
+        pipe = StagingPipeline(stream, depth=2)
+        for _ in pipe:
+            pass
+        dt = time.perf_counter() - t0
+        assert pipe.rows_staged == n
+        stream.close()
+        pipe.close()
+        best = min(best, dt)
+    RESULTS["recordio_staged_rows_per_sec"] = round(n / best, 1)
     RESULTS["recordio_staged_mb_per_sec"] = round(
-        os.path.getsize(path) / dt / 1e6, 1
+        os.path.getsize(path) / best / 1e6, 1
     )
 
 
