@@ -553,6 +553,29 @@ def test_indexed_rowrec_via_uri_sugar(tmp_path):
     s.close()
 
 
+def test_indexed_sugar_composes_with_threaded_fanout(tmp_path):
+    """?index=&shuffle= + nthread>1 (ShardedFusedBatches): every row
+    lands exactly once across the interleaved count-indexed sub-shards."""
+    from dmlc_core_tpu.staging import ell_batches
+
+    n, k = 600, 3
+    rng = np.random.default_rng(5)
+    blk = RowBlock(
+        offset=np.arange(n + 1, dtype=np.int64) * k,
+        label=np.arange(n, dtype=np.float32),
+        index=rng.integers(0, 50, n * k).astype(np.uint32),
+        value=rng.normal(size=n * k).astype(np.float32),
+    )
+    rec, idx = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    with FileStream(rec, "w") as f, FileStream(idx, "w") as fi:
+        write_rowrec(f, [blk], index_stream=fi)
+    spec = BatchSpec(batch_size=50, layout="ell", max_nnz=k)
+    s = ell_batches(f"{rec}?index={idx}&shuffle=1&seed=2", spec, nthread=2)
+    labels = [x for b in s for x in b.labels[: b.n_valid].tolist()]
+    s.close()
+    assert sorted(labels) == list(range(n))
+
+
 def test_indexed_rowrec_sugar_on_parser_path(tmp_path):
     """?index=&shuffle= must work through create_row_block_iter /
     create_parser too, not only the fused native path: the registry
