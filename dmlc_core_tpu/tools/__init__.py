@@ -173,6 +173,57 @@ def _cmd_rowrec(args) -> int:
     return 0
 
 
+def _cmd_dump(args) -> int:
+    """Parsed rows → text on stdout (default: rowrec .rec → libsvm; any
+    ``?format=`` source works). ``%.9g`` keeps f32 labels/weights/values
+    exact; qid and libfm fields are emitted when present, bare indices
+    for binary features — the dump is a faithful inverse, streamed block
+    by block (``--limit`` on a huge file reads only what it prints)."""
+    from ..data import create_parser
+    from ..io.uri import URISpec
+
+    uspec = URISpec(args.src, args.part, args.num_parts)
+    uri = args.src
+    if "format" not in uspec.args:
+        head, sep, frag = uri.partition("#")
+        head += ("&" if "?" in head else "?") + "format=rowrec"
+        uri = head + sep + frag
+    parser = create_parser(uri, args.part, args.num_parts, threaded=False)
+    rows = 0
+    out = sys.stdout
+    try:
+        for blk in iter(parser):
+            weights, qid, field, vals = (
+                blk.weight, blk.qid, blk.field, blk.value
+            )
+            for i in range(blk.size):
+                b, e = int(blk.offset[i]), int(blk.offset[i + 1])
+                label = f"{float(blk.label[i]):.9g}"
+                if weights is not None and float(weights[i]) != 1.0:
+                    label += f":{float(weights[i]):.9g}"
+                toks = [label]
+                if qid is not None:
+                    toks.append(f"qid:{int(qid[i])}")
+                for j in range(b, e):
+                    idx = int(blk.index[j])
+                    if field is not None:
+                        v = 1.0 if vals is None else float(vals[j])
+                        toks.append(f"{int(field[j])}:{idx}:{v:.9g}")
+                    elif vals is None:
+                        toks.append(str(idx))  # binary feature
+                    else:
+                        toks.append(f"{idx}:{float(vals[j]):.9g}")
+                out.write(" ".join(toks) + "\n")
+                rows += 1
+                if args.limit and rows >= args.limit:
+                    print(f"dumped {rows} rows (limit)", file=sys.stderr)
+                    return 0
+    finally:
+        parser.close()
+    print(f"dumped {rows} rows", file=sys.stderr)
+    return 0
+
+
 def _cmd_info(args) -> int:
     """Runtime feature report (build_info): native kernels, env flags,
     accelerator runtime — the base.h feature macros as runtime facts."""
@@ -239,6 +290,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="convert only this shard of src")
     rr.add_argument("--num-parts", default=1, type=int)
     rr.set_defaults(fn=_cmd_rowrec)
+
+    dp = sub.add_parser(
+        "dump", help="decode a rowrec .rec back to libsvm text"
+    )
+    dp.add_argument("src", help=".rec URI (shardable)")
+    dp.add_argument("--part", default=0, type=int)
+    dp.add_argument("--num-parts", default=1, type=int)
+    dp.add_argument("--limit", default=0, type=int,
+                    help="stop after N rows (0 = all)")
+    dp.set_defaults(fn=_cmd_dump)
 
     info = sub.add_parser("info", help="runtime feature report (JSON)")
     info.set_defaults(fn=_cmd_info)

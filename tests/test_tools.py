@@ -154,6 +154,74 @@ def test_error_paths_return_nonzero(tmp_path, capsys):
     assert rc == 1 and "error:" in err
 
 
+def test_dump_inverts_rowrec_conversion(libsvm_file, tmp_path, capsys):
+    """libsvm → rowrec → dump → parse again must preserve every row's
+    label/indices/values (text→rec→text round trip, %.9g exact for f32);
+    --limit and sharding behave."""
+    rec = str(tmp_path / "d.rec")
+    rc, _, _ = run_cli(["rowrec", libsvm_file, rec, "--format", "libsvm"],
+                       capsys)
+    assert rc == 0
+    rc, out, err = run_cli(["dump", rec], capsys)
+    assert rc == 0 and "dumped 40 rows" in err
+    back = str(tmp_path / "back.libsvm")
+    open(back, "w").write(out)
+
+    def blocks(uri):
+        it = create_row_block_iter(uri)
+        offs, labels, idxs, vals = [0], [], [], []
+        for b in it:
+            labels.extend(np.asarray(b.label).tolist())
+            idxs.extend(np.asarray(b.index).tolist())
+            vals.extend(np.asarray(b.value).tolist())
+        return labels, idxs, vals
+
+    l1, i1, v1 = blocks(libsvm_file + "?format=libsvm")
+    l2, i2, v2 = blocks(back + "?format=libsvm")
+    assert l1 == l2 and i1 == i2
+    np.testing.assert_allclose(v1, v2, rtol=0, atol=0)
+
+    rc, out, err = run_cli(["dump", rec, "--limit", "5"], capsys)
+    assert rc == 0 and "dumped 5 rows (limit)" in err
+    assert len(out.splitlines()) == 5
+    rc, out, _ = run_cli(["dump", rec, "--part", "1", "--num-parts", "2"],
+                         capsys)
+    assert rc == 0 and len(out.splitlines()) == 20
+
+
+def test_dump_fidelity_edge_cases(tmp_path, capsys):
+    """Binary features dump as bare indices (value=None must not crash),
+    f32 labels/weights keep exact bits (%.9g), qid and libfm fields are
+    preserved."""
+    svm = tmp_path / "x.libsvm"
+    svm.write_text(
+        "0.123456789:2.5 qid:7 3 9 12\n"   # weight, qid, binary features
+        "1 0:0.25 5:0.5\n"
+    )
+    rc, out, err = run_cli(["dump", f"{svm}?format=libsvm"], capsys)
+    assert rc == 0 and "dumped 2 rows" in err
+    l1, l2 = out.splitlines()
+    # value presence is block-level (reference semantics): a mixed chunk
+    # materializes 1.0 for binary features — equivalent, still faithful
+    assert l1 == "0.123456791:2.5 qid:7 3:1 9:1 12:1"  # f32-exact label
+    # qid defaults to 0 for rows without one (reference atoll semantics),
+    # so the faithful dump carries qid:0 — re-parsing gives identical data
+    assert l2 == "1 qid:0 0:0.25 5:0.5"
+
+    # an all-binary chunk has value=None → bare indices, no crash
+    binsvm = tmp_path / "b.libsvm"
+    binsvm.write_text("1 3 9\n0 2\n")
+    rc, out, _ = run_cli(["dump", f"{binsvm}?format=libsvm"], capsys)
+    assert rc == 0
+    assert out.splitlines() == ["1 3 9", "0 2"]
+
+    fm = tmp_path / "x.libfm"
+    fm.write_text("1 2:30:0.75 4:50\n")
+    rc, out, err = run_cli(["dump", f"{fm}?format=libfm"], capsys)
+    assert rc == 0
+    assert out.splitlines() == ["1 2:30:0.75 4:50:1"]
+
+
 def test_info_reports_features(capsys):
     """`tools info` emits the build_info report: kernel flags present and
     consistent with the loaded native module (base.h feature macros as
