@@ -40,6 +40,41 @@ def test_uri_parse():
     assert u3.protocol == "file://" and u3.path == "/local/path"
 
 
+def test_urispec_rejoin_roundtrip_property():
+    """rejoin_query must be the exact inverse of URISpec's query parse
+    for every args dict free of the separator characters — the whole
+    URI-sugar machinery (split factory, parser registry, fused
+    producers) re-serializes through this pair, so drift would silently
+    drop dataset options."""
+    hyp = pytest.importorskip("hypothesis")  # baked into the image;
+    given, settings = hyp.given, hyp.settings  # skip cleanly elsewhere
+    st = pytest.importorskip("hypothesis.strategies")
+
+    from dmlc_core_tpu.io.uri import rejoin_query
+
+    key = st.text(
+        alphabet=st.characters(blacklist_characters="?&=#", min_codepoint=33,
+                               max_codepoint=126),
+        min_size=1, max_size=12,
+    )
+    val = st.text(
+        alphabet=st.characters(blacklist_characters="?&#", min_codepoint=32,
+                               max_codepoint=126),
+        min_size=0, max_size=20,
+    )
+
+    @settings(max_examples=200, deadline=None)
+    @given(st.dictionaries(key, val, max_size=6))
+    def check(args):
+        uri = "gs://b/data.rec" + rejoin_query(args) + "#cachefile"
+        spec = URISpec(uri)
+        assert spec.uri == "gs://b/data.rec"
+        assert spec.args == args
+        assert spec.cache_file == "cachefile"
+
+    check()
+
+
 def test_urispec_sugar():
     s = URISpec("gs://b/train.libsvm?format=libsvm&nthread=4#cache")
     assert s.uri == "gs://b/train.libsvm"
