@@ -503,6 +503,20 @@ class S3FileSystem(FileSystem):
         resp.close()
         return FileInfo(uri, size, "file")
 
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        """DELETE object; with ``recursive``, every object under the
+        prefix (object stores have no directories — a 'directory' delete
+        is a listed prefix sweep). Powers remote checkpoint retention."""
+        if recursive:
+            infos = self.list_directory_recursive(uri)
+            if infos:
+                for info in infos:
+                    b, k = self.split_uri(info.path)
+                    self.request("DELETE", self.object_url(b, k))
+                return
+        bucket, key = self.split_uri(uri)
+        self.request("DELETE", self.object_url(bucket, key))
+
     def list_directory(self, uri: str) -> List[FileInfo]:
         """ListObjectsV2 with '/' delimiter (reference ListObjects,
         s3_filesys.cc:1018)."""
@@ -658,6 +672,17 @@ class WebHdfsFileSystem(FileSystem):
         ftype = "directory" if st["type"] == "DIRECTORY" else "file"
         return FileInfo(uri, int(st.get("length", 0)), ftype)
 
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        url = self._url(
+            uri, "DELETE", recursive="true" if recursive else "false"
+        )
+        resp = _request(url, "DELETE")
+        try:
+            ok = json.loads(resp.read() or b"{}").get("boolean", False)
+        finally:
+            resp.close()
+        check(ok, f"webhdfs delete refused for {uri}")
+
     def list_directory(self, uri: str) -> List[FileInfo]:
         body = _read_all(self._url(uri, "LISTSTATUS"))
         statuses = json.loads(body)["FileStatuses"]["FileStatus"]
@@ -728,6 +753,15 @@ class AzureBlobFileSystem(FileSystem):
         size = int(resp.headers.get("Content-Length") or 0)
         resp.close()
         return FileInfo(uri, size, "file")
+
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        if recursive:
+            infos = self.list_directory(uri)
+            if infos:
+                for info in infos:
+                    _request(self._url(info.path), "DELETE").close()
+                return
+        _request(self._url(uri), "DELETE").close()
 
     def list_directory(self, uri: str) -> List[FileInfo]:
         u = URI(uri)

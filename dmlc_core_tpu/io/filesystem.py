@@ -69,6 +69,16 @@ class FileSystem:
         except (OSError, Error):
             return False
 
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        """Remove a file/object; with ``recursive``, a directory/prefix.
+
+        The reference's FileSystem has no delete — its tests clean up via
+        shell — but checkpoint retention (§5.4) needs real deletion on
+        every backend a checkpoint can be written to, or remote stores
+        accumulate stale steps forever. Raises on unsupported backends.
+        """
+        raise Error(f"{type(self).__name__} does not support delete")
+
     def list_directory_recursive(self, uri: str) -> List[FileInfo]:
         """BFS expansion (reference ListDirectoryRecursive,
         src/io/filesys.cc:9-25)."""
@@ -142,6 +152,13 @@ class LocalFileSystem(FileSystem):
             out.append(FileInfo(path=f"{prefix}/{name}", size=st.st_size, type=kind))
         return out
 
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        path = self._path(uri)
+        if recursive and os.path.isdir(path):
+            shutil.rmtree(path)
+        else:
+            os.remove(path)
+
 
 class MemoryFileSystem(FileSystem):
     """Process-global in-memory store under ``mem://`` — the hermetic test
@@ -202,6 +219,19 @@ class MemoryFileSystem(FileSystem):
             else:
                 seen[full] = FileInfo(path=full, size=len(data), type="file")
         return list(seen.values())
+
+    def delete(self, uri: str, recursive: bool = False) -> None:
+        if uri in self._store:
+            del self._store[uri]
+            return
+        prefix = uri.rstrip("/") + "/"
+        keys = [k for k in self._store if k.startswith(prefix)]
+        if not keys:
+            raise Error(f"mem:// key not found: {uri}")
+        if not recursive:
+            raise Error(f"mem:// {uri} is a prefix; pass recursive=True")
+        for k in keys:
+            del self._store[k]
 
     @classmethod
     def reset(cls) -> None:

@@ -109,7 +109,9 @@ def write_ndarray(stream: Stream, arr: np.ndarray) -> None:
     reference serializes vector<T> (serializer.h:130-147) — a 1-D special
     case of this.
     """
-    arr = np.ascontiguousarray(arr)
+    # NOT ascontiguousarray: it promotes 0-d arrays to 1-d, silently
+    # changing the shape on the wire (scalars in checkpoint pytrees)
+    arr = np.asarray(arr, order="C")
     write_str(stream, str(arr.dtype))
     write_scalar(stream, arr.ndim, "uint32")
     for d in arr.shape:

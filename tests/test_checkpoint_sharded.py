@@ -1,0 +1,394 @@
+"""Sharded / multi-process checkpointing (§5.4 on a pod).
+
+The r3 verdict's top gap: a tp-sharded param tree (the dryrun's FM with
+``v: P(None,'model')``) could not be checkpointed in a real multi-process
+run because ``np.asarray`` on a non-addressable array crashes. These
+tests pin the new story end to end:
+
+- single-process: sharded layout round-trips and RESHARDS onto a
+  different mesh at restore time;
+- completeness: a .d directory without its manifest is invisible
+  (torn checkpoints can never be 'latest');
+- two REAL processes: train the dryrun FM config, checkpoint mid-run
+  (each process writes its own replica-0 shards), restart, and the
+  resumed loss trajectory matches the uninterrupted one bit-for-bit —
+  the reference's rabit Checkpoint/LoadCheckpoint resume contract
+  (SURVEY §5.4, reference include/dmlc/io.h:132-146 primitives).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fm_params_on_mesh(mesh_shape, axis_names, rules):
+    import jax
+    from jax.sharding import NamedSharding
+
+    from dmlc_core_tpu.models import FactorizationMachine
+    from dmlc_core_tpu.parallel import make_mesh
+
+    mesh = make_mesh(mesh_shape, axis_names)
+    model = FactorizationMachine(64, 8)
+    params = model.init(jax.random.PRNGKey(0))
+    placed = {
+        k: jax.device_put(v, NamedSharding(mesh, rules.get(k, P_empty())))
+        for k, v in params.items()
+    }
+    return mesh, model, placed
+
+
+def P_empty():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec()
+
+
+def test_sharded_roundtrip_reshards_onto_new_mesh(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    rules = {"v": P(None, "model")}
+    mesh_a, _, params = _fm_params_on_mesh((4, 2), ("data", "model"), rules)
+
+    ck = Checkpointer(str(tmp_path / "ck"), sharded=True)
+    path = ck.save(7, params)
+    assert path is not None and path.endswith(".d")
+    assert ck.steps() == [7]
+
+    # restore onto a DIFFERENT mesh: 2x4 instead of 4x2 — 'model' now
+    # spans 4 devices, so every leaf must be re-placed, not re-loaded
+    mesh_b, _, template = _fm_params_on_mesh((2, 4), ("data", "model"), rules)
+    step, back = ck.restore(template=template)
+    assert step == 7
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(back[k]), np.asarray(params[k])
+        )
+        assert back[k].sharding == template[k].sharding, k
+    # v really is sharded over the new model axis (4-way on dim 1)
+    vshard = back["v"].addressable_shards[0]
+    assert np.asarray(vshard.data).shape[1] == params["v"].shape[1] // 4
+
+
+def test_sharded_without_template_returns_host(tmp_path):
+    from jax.sharding import PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    rules = {"v": P(None, "model")}
+    _, _, params = _fm_params_on_mesh((4, 2), ("data", "model"), rules)
+    ck = Checkpointer(str(tmp_path / "ck"), sharded=True)
+    ck.save(1, {"params": params, "step": 1, "note": "meta"})
+    step, back = ck.restore()
+    assert step == 1 and back["note"] == "meta" and back["step"] == 1
+    assert isinstance(back["params"]["v"], np.ndarray)
+    np.testing.assert_array_equal(
+        back["params"]["v"], np.asarray(params["v"])
+    )
+
+
+def test_torn_sharded_checkpoint_is_invisible(tmp_path):
+    from dmlc_core_tpu.checkpoint import Checkpointer, save_pytree
+
+    base = tmp_path / "ck"
+    ck = Checkpointer(str(base), process_index=0)
+    ck.save(3, {"w": np.ones(4, np.float32)})  # legacy complete ckpt
+    # a torn sharded checkpoint: shard file present, manifest missing
+    torn = base / "ckpt-0000000009.d"
+    torn.mkdir(parents=True)
+    save_pytree(str(torn / "shard-00000.bin"), {"proc": 0, "chunks": {}})
+    assert ck.steps() == [3]
+    step, _ = ck.restore()
+    assert step == 3
+
+
+def test_process_local_arrays_dedupe_proc0_wins(tmp_path):
+    """A fully-addressable (process-local) jax array makes EVERY process
+    emit a full-range chunk — exact-duplicate ranges must restore with
+    process 0's copy winning (legacy proc-0-writes discipline), counted
+    once in the coverage check."""
+    import jax
+
+    from dmlc_core_tpu.checkpoint import (
+        load_pytree_sharded,
+        save_pytree_sharded,
+    )
+
+    base = str(tmp_path / "ck.d")
+    # simulate 2 processes saving: each holds a DIFFERENT local copy
+    for pid, fill in ((0, 1.0), (1, 2.0)):
+        local = jax.device_put(np.full(4, fill, np.float32))
+        assert local.is_fully_addressable
+        save_pytree_sharded(base, {"step_ctr": local}, pid, 2)
+    back = load_pytree_sharded(base)
+    np.testing.assert_array_equal(back["step_ctr"], np.full(4, 1.0))
+
+
+def test_prune_removes_torn_debris(tmp_path):
+    from dmlc_core_tpu.checkpoint import Checkpointer, save_pytree
+
+    base = tmp_path / "ck"
+    ck = Checkpointer(str(base), keep=2, process_index=0)
+    ck.save(1, {"w": np.ones(2, np.float32)})
+    # crash debris: torn .d (no manifest) + orphaned .tmp, both older
+    # than the next complete save
+    torn = base / "ckpt-0000000002.d"
+    torn.mkdir()
+    save_pytree(str(torn / "shard-00000.bin"), {"proc": 0, "chunks": {}})
+    (base / "ckpt-0000000002.bin.tmp").write_bytes(b"junk")
+    ck.save(3, {"w": np.ones(2, np.float32)})
+    names = set(os.listdir(base))
+    assert "ckpt-0000000002.d" not in names
+    assert "ckpt-0000000002.bin.tmp" not in names
+    assert ck.steps() == [1, 3]
+
+
+def test_same_step_resave_never_shadowed(tmp_path):
+    """Re-saving a step in the OTHER layout must invalidate the old one:
+    a stale .d may not shadow a newer .bin and vice versa."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.parallel import make_mesh
+
+    mesh = make_mesh((8,), ("data",))
+    ck = Checkpointer(str(tmp_path / "ck"), process_index=0)
+    old = jax.device_put(
+        np.zeros(8, np.float32), NamedSharding(mesh, P("data"))
+    )
+    ck_sharded = Checkpointer(str(tmp_path / "ck"), sharded=True)
+    ck_sharded.save(5, {"w": old})
+    # legacy re-save of the SAME step with new data
+    ck.save(5, {"w": np.ones(8, np.float32)})
+    _, back = ck.restore()
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.ones(8))
+    assert not os.path.isdir(tmp_path / "ck" / "ckpt-0000000005.d")
+    # and the reverse: sharded re-save invalidates the .bin
+    ck_sharded.save(
+        5, {"w": jax.device_put(np.full(8, 2.0, np.float32),
+                                NamedSharding(mesh, P("data")))}
+    )
+    _, back = ck_sharded.restore()
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.full(8, 2.0))
+    assert not os.path.exists(tmp_path / "ck" / "ckpt-0000000005.bin")
+
+
+def test_remote_same_step_resave_and_retention():
+    """On an object-store backend (mem:// stands in) the same-step
+    shadow fix and retention must work through FileSystem.delete — not
+    silently no-op like the old local-only removal."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.io.filesystem import MemoryFileSystem
+    from dmlc_core_tpu.parallel import make_mesh
+
+    MemoryFileSystem.reset()
+    try:
+        mesh = make_mesh((8,), ("data",))
+        base = "mem://ck/run1"
+        sharded = Checkpointer(base, keep=2, sharded=True)
+        legacy = Checkpointer(base, keep=2, process_index=0)
+        old = jax.device_put(
+            np.zeros(8, np.float32), NamedSharding(mesh, P("data"))
+        )
+        sharded.save(5, {"w": old})
+        legacy.save(5, {"w": np.ones(8, np.float32)})  # same-step re-save
+        _, back = legacy.restore()
+        np.testing.assert_array_equal(np.asarray(back["w"]), np.ones(8))
+        # retention across layouts on the remote store
+        legacy.save(6, {"w": np.ones(8, np.float32)})
+        sharded.save(
+            7, {"w": jax.device_put(np.ones(8, np.float32),
+                                    NamedSharding(mesh, P("data")))}
+        )
+        sharded.save(
+            8, {"w": jax.device_put(np.ones(8, np.float32),
+                                    NamedSharding(mesh, P("data")))}
+        )
+        assert sharded.steps() == [7, 8]  # 5 and 6 pruned remotely
+    finally:
+        MemoryFileSystem.reset()
+
+
+def test_legacy_restore_applies_template(tmp_path):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.parallel import make_mesh
+
+    ck = Checkpointer(str(tmp_path / "ck"), process_index=0)
+    w = np.arange(16, dtype=np.float32)
+    ck.save(2, {"w": w})
+    mesh = make_mesh((8,), ("data",))
+    tmpl = {"w": jax.device_put(w, NamedSharding(mesh, P("data")))}
+    _, back = ck.restore(template=tmpl)
+    assert back["w"].sharding == tmpl["w"].sharding
+    np.testing.assert_array_equal(np.asarray(back["w"]), w)
+
+
+N_STEPS = 6
+CKPT_STEP = 3
+
+WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address={coord!r},
+    num_processes=2,
+    process_id={pid},
+)
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_core_tpu.checkpoint import Checkpointer
+from dmlc_core_tpu.models import FactorizationMachine
+from dmlc_core_tpu.parallel import data_parallel_step, make_mesh
+
+NUM_FEATURES, EMBED, BATCH, K = 64, 8, 16, 4
+RULES = {{"v": P(None, "model")}}
+
+mesh = make_mesh((4, 2), ("data", "model"))  # 8 global devices, 2 procs
+
+def gput(x, spec):
+    x = np.asarray(x)
+    sh = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+model = FactorizationMachine(NUM_FEATURES, EMBED)
+host_init = {{k: np.asarray(v) for k, v in
+             model.init(jax.random.PRNGKey(0)).items()}}
+params = {{k: gput(v, RULES.get(k, P())) for k, v in host_init.items()}}
+assert not params["v"].is_fully_addressable  # the r3 crash precondition
+
+def batches():
+    rng = np.random.default_rng(42)
+    out = []
+    for _ in range({n_steps}):
+        out.append({{
+            "indices": gput(rng.integers(0, NUM_FEATURES, (BATCH, K))
+                            .astype(np.int32), P("data", None)),
+            "values": gput(rng.normal(size=(BATCH, K)).astype(np.float32),
+                           P("data", None)),
+            "nnz": gput(np.full(BATCH, K, np.int32), P("data")),
+            "labels": gput(rng.integers(0, 2, BATCH).astype(np.float32),
+                           P("data")),
+            "weights": gput(np.ones(BATCH, np.float32), P("data")),
+        }})
+    return out
+
+step = data_parallel_step(
+    lambda p, b: model.sgd_step(p, b, lr=0.1), mesh,
+    param_rules=RULES, donate_params=False,
+)
+ck = Checkpointer({ckdir!r})
+mode = {mode!r}
+losses = []
+bs = batches()
+if mode == "straight":
+    for i in range({n_steps}):
+        params, loss = step(params, bs[i])
+        losses.append(float(loss))
+        if i + 1 == {ckpt_step}:
+            uri = ck.save(i + 1, params)
+            assert uri is not None and uri.endswith(".d"), uri
+else:
+    got_step, params = ck.restore(template=params)
+    assert got_step == {ckpt_step}, got_step
+    assert not params["v"].is_fully_addressable
+    for i in range({ckpt_step}, {n_steps}):
+        params, loss = step(params, bs[i])
+        losses.append(float(loss))
+
+with open({out!r} + str({pid}), "w") as f:
+    f.write(" ".join(np.float32(x).tobytes().hex() for x in losses))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_pair(tmp_path, tag, mode, ckdir, out):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=4"]
+    )
+    procs = []
+    for pid in range(2):
+        script = tmp_path / f"{tag}{pid}.py"
+        script.write_text(
+            textwrap.dedent(
+                WORKER.format(
+                    repo=REPO, coord=coord, pid=pid, ckdir=ckdir,
+                    mode=mode, out=out, n_steps=N_STEPS,
+                    ckpt_step=CKPT_STEP,
+                )
+            )
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(script)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+        )
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for p, (o, e) in zip(procs, outs):
+        assert p.returncode == 0, f"{tag} worker failed:\n{o}\n{e}"
+
+
+@pytest.mark.slow
+def test_two_process_midrun_checkpoint_resume_bitexact(tmp_path):
+    """Straight 6-step run (checkpointing at step 3) == restart from the
+    step-3 checkpoint and run steps 4-6: loss trajectories bit-identical,
+    with v tp-sharded P(None,'model') across 2 processes the whole time."""
+    ckdir = str(tmp_path / "ck")
+    out_s = str(tmp_path / "straight")
+    out_r = str(tmp_path / "resume")
+    _run_pair(tmp_path, "s", "straight", ckdir, out_s)
+
+    # the sharded layout really is multi-file: one shard per process
+    dirs = [d for d in os.listdir(ckdir) if d.endswith(".d")]
+    assert len(dirs) == 1
+    files = sorted(os.listdir(os.path.join(ckdir, dirs[0])))
+    assert files == ["MANIFEST.bin", "shard-00000.bin", "shard-00001.bin"]
+
+    _run_pair(tmp_path, "r", "resume", ckdir, out_r)
+
+    for pid in range(2):
+        straight = open(out_s + str(pid)).read().split()
+        resumed = open(out_r + str(pid)).read().split()
+        assert len(straight) == N_STEPS and len(resumed) == N_STEPS - CKPT_STEP
+        # bit-for-bit: hex of the float32 payloads, not approx-equal
+        assert straight[CKPT_STEP:] == resumed, (straight, resumed)

@@ -243,6 +243,15 @@ class FakeS3Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_DELETE(self):
+        if not self._check_auth():
+            return
+        key, _ = self._key()
+        self.STORE.pop(key, None)  # S3 DELETE is idempotent: 204 either way
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_POST(self):
         if not self._check_auth():
             return
@@ -413,6 +422,23 @@ def test_s3_write_read_roundtrip(s3):
     assert all(
         a.startswith("AWS4-HMAC-SHA256") for a in FakeS3Handler.SAW_AUTH
     )
+
+
+def test_s3_delete_object_and_prefix(s3):
+    FakeS3Handler.STORE.update(
+        {
+            "bkt/ck/a.bin": b"a",
+            "bkt/ck/sub/b.bin": b"b",
+            "bkt/keep.txt": b"k",
+        }
+    )
+    fs = FileSystem.get_instance("s3://bkt/ck")
+    fs.delete("s3://bkt/ck/a.bin")
+    assert "bkt/ck/a.bin" not in FakeS3Handler.STORE
+    # recursive prefix sweep (checkpoint retention on object stores)
+    fs.delete("s3://bkt/ck", recursive=True)
+    assert [k for k in FakeS3Handler.STORE if k.startswith("bkt/ck")] == []
+    assert "bkt/keep.txt" in FakeS3Handler.STORE
 
 
 def test_s3_multipart_upload(s3, monkeypatch):
