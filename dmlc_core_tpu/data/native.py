@@ -28,6 +28,7 @@ __all__ = [
     "HAS_ELL",
     "HAS_CSV_DENSE",
     "HAS_LIBFM_ELL",
+    "HAS_LIBSVM_ELL",
     "parse_libsvm",
     "parse_csv",
     "parse_libfm",
@@ -35,6 +36,7 @@ __all__ = [
     "parse_csv_dense",
     "parse_rowrec_ell",
     "parse_libfm_ell",
+    "parse_libsvm_ell",
     "source_hash",
     "load",
 ]
@@ -44,6 +46,7 @@ HAS_DENSE = False      # fused libsvm->dense-batch kernel present in the .so
 HAS_ELL = False        # fused recordio rowrec->ELL-batch kernel present
 HAS_CSV_DENSE = False  # fused csv->dense-batch kernel present
 HAS_LIBFM_ELL = False  # fused libfm->ELL-batch kernel present
+HAS_LIBSVM_ELL = False  # fused libsvm->ELL-batch kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -116,14 +119,15 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
     an in-session rebuild (the rebuilt file is a new inode, so dlopen
     returns a fresh handle; the old one is left to the process lifetime).
     """
-    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_LIBFM_ELL, _LIB
+    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_LIBFM_ELL, \
+        HAS_LIBSVM_ELL, _LIB
     with _LOCK:
         if _LIB is not None and not force:
             return AVAILABLE
         if force:
             _LIB = None
             AVAILABLE = HAS_DENSE = HAS_ELL = HAS_CSV_DENSE = False
-            HAS_LIBFM_ELL = False
+            HAS_LIBFM_ELL = HAS_LIBSVM_ELL = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES
@@ -185,6 +189,15 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
                     ctypes.c_int32, ctypes.POINTER(_DenseResult)]
                 lib.dmlc_parse_libfm_ell.restype = None
                 HAS_LIBFM_ELL = True
+            if hasattr(lib, "dmlc_parse_libsvm_ell"):
+                lib.dmlc_parse_libsvm_ell.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.POINTER(_DenseResult)]
+                lib.dmlc_parse_libsvm_ell.restype = None
+                HAS_LIBSVM_ELL = True
             if hasattr(lib, "dmlc_source_hash"):
                 lib.dmlc_source_hash.restype = ctypes.c_char_p
                 lib.dmlc_source_hash.argtypes = []
@@ -470,6 +483,48 @@ def parse_libfm_ell(
     capacity, K = _check_ell_buffers(indices, values, nnz, labels, weights)
     res = _DenseResult()
     _LIB.dmlc_parse_libfm_ell(
+        ctypes.c_void_p(mem.ctypes.data + offset),
+        ctypes.c_int64(mem.size - offset),
+        ctypes.c_int32(base),
+        ctypes.c_int64(K),
+        ctypes.c_int32(1 if values.dtype == np.float16 else 0),
+        ctypes.c_void_p(indices.ctypes.data),
+        ctypes.c_void_p(values.ctypes.data),
+        ctypes.c_void_p(nnz.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.c_int32(cr_hint),
+        ctypes.byref(res),
+    )
+    return res.rows_written, res.bytes_consumed, res.truncated, res.has_cr
+
+
+def parse_libsvm_ell(
+    chunk,
+    offset: int,
+    base: int,
+    indices: np.ndarray,
+    values: np.ndarray,
+    nnz: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+    cr_hint: int = -1,
+) -> Optional[Tuple[int, int, int, int]]:
+    """Fused libsvm text parse → ELL batch rows (buffer contract of
+    ``parse_rowrec_ell``, resumable-chunk contract of
+    ``parse_libsvm_dense``). ``base`` is the resolved indexing base —
+    callers resolve libsvm auto mode against the file head. Returns
+    (rows_written, bytes_consumed, truncated, has_cr), or None if the
+    kernel is missing."""
+    if not HAS_LIBSVM_ELL:
+        return None
+    mem = np.frombuffer(chunk, dtype=np.uint8)
+    capacity, K = _check_ell_buffers(indices, values, nnz, labels, weights)
+    res = _DenseResult()
+    _LIB.dmlc_parse_libsvm_ell(
         ctypes.c_void_p(mem.ctypes.data + offset),
         ctypes.c_int64(mem.size - offset),
         ctypes.c_int32(base),

@@ -10,6 +10,7 @@ from .fused import (
     FusedDenseCSVBatches,
     FusedDenseLibSVMBatches,
     FusedEllLibFMBatches,
+    FusedEllLibSVMBatches,
     FusedEllRowRecBatches,
     ShardedFusedBatches,
     dense_batches,
@@ -24,6 +25,7 @@ __all__ = [
     "FusedDenseCSVBatches",
     "FusedDenseLibSVMBatches",
     "FusedEllLibFMBatches",
+    "FusedEllLibSVMBatches",
     "FusedEllRowRecBatches",
     "ShardedFusedBatches",
     "StagingPipeline",

@@ -19,6 +19,13 @@ whole batches.
   (dmlc_parse_rowrec_ell). Semantics match RowRecParser +
   FixedShapeBatcher('ell') composed; rows wider than K keep their first K
   features (counted in ``truncated_nnz``).
+- FusedEllLibSVMBatches: libsvm text → ELL [B,K]
+  (dmlc_parse_libsvm_ell) — sparse Criteo-style libsvm straight to the
+  device layout, no CSR detour (the reference's premier text hot path,
+  libsvm_parser.h:86-169).
+- FusedEllLibFMBatches: libfm text → ELL [B,K] (dmlc_parse_libfm_ell);
+  fields are validated then dropped (the ELL device layout carries no
+  field axis).
 
 Producers expose ``ring_slots`` so consumers composing them with a
 prefetch/in-flight pipeline (StagingPipeline) can validate the ring is
@@ -858,6 +865,66 @@ class FusedEllLibFMBatches(_EllSlotMixin, _FusedTextBatches):
         self._pad_ell_tail(slot, fill)
 
 
+class FusedEllLibSVMBatches(_EllSlotMixin, _FusedTextBatches):
+    """libsvm text → ELL [B,K] via dmlc_parse_libsvm_ell.
+
+    Semantics match LibSVMParser + FixedShapeBatcher('ell') composed —
+    the sparse layout a real Criteo-libsvm file needs (reference
+    src/data/libsvm_parser.h:86-169 is the reference's premier text hot
+    path). '#' comments and a second 'qid:N' token are consumed like the
+    dense kernel; ``indexing_mode`` rides the constructor or
+    ``?indexing_mode=`` on the URI; auto (-1) resolves ONCE against the
+    file head so shards can never disagree.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        part_index: int = 0,
+        num_parts: int = 1,
+        indexing_mode: int = 0,
+        ring: int = 8,
+    ) -> None:
+        check(native.HAS_LIBSVM_ELL,
+              "native fused libsvm ELL kernel not loaded")
+        check(spec.layout == "ell", "fused libsvm path requires layout='ell'")
+        check(spec.index_dtype == np.dtype(np.int32),
+              "fused ELL path stages int32 indices")
+        super().__init__(uri, spec, part_index, num_parts, ring)
+        if "indexing_mode" in self.uspec.args:
+            indexing_mode = int(self.uspec.args["indexing_mode"])
+        if indexing_mode < 0 and num_parts > 1:
+            indexing_mode = _probe_base_from_uri(self.uspec.uri)
+        self._base: Optional[int] = (
+            None if indexing_mode < 0 else (1 if indexing_mode > 0 else 0)
+        )
+
+    def _first_chunk(self, chunk, off: int) -> int:
+        off = super()._first_chunk(chunk, off)
+        if self._base is None:
+            self._base = _probe_base(chunk)
+        return off
+
+    def _alloc_slot(self):
+        return self._alloc_ell_slot()
+
+    def _parse(self, chunk, off, slot, fill, cr_hint):
+        indices, values, nnz, labels, weights, _packed = slot
+        rows, consumed, trunc, cr_hint = native.parse_libsvm_ell(
+            chunk, off, self._base or 0, indices, values, nnz, labels,
+            weights, fill, cr_hint,
+        )
+        self.truncated_nnz += trunc
+        return rows, consumed, cr_hint
+
+    def _emit(self, slot, n_valid: int) -> Batch:
+        return self._emit_ell(slot, n_valid)
+
+    def _pad_tail(self, slot, fill: int) -> None:
+        self._pad_ell_tail(slot, fill)
+
+
 def ell_batches(
     uri: str,
     spec: BatchSpec,
@@ -869,29 +936,56 @@ def ell_batches(
     indexing_mode: int = 0,
 ):
     """Best-available ELL Batch stream for a rowrec RecordIO URI or a
-    libfm text URI.
+    libsvm/libfm text URI.
 
-    ``format``: 'rowrec' | 'libfm' | 'auto' (``?format=`` from the URI,
-    defaulting to rowrec). ``indexing_mode`` applies to the libfm path
-    (same contract as ``dense_batches``; ``?indexing_mode=`` on the URI
-    wins). Uses the fused native kernel when loaded, otherwise the
-    generic parser → FixedShapeBatcher path with the same semantics.
-    Either way the result is iterable and has ``.close()``.
-    ``nthread`` > 1 fans the fused parse out over threads
+    ``format``: 'rowrec' | 'libsvm' | 'libfm' | 'auto' (``?format=``
+    from the URI, defaulting to rowrec). ``indexing_mode`` applies to
+    the libsvm/libfm paths (same contract as ``dense_batches``;
+    ``?indexing_mode=`` on the URI wins). Uses the fused native kernel
+    when loaded, otherwise the generic parser → FixedShapeBatcher path
+    with the same semantics. Either way the result is iterable and has
+    ``.close()``. ``nthread`` > 1 fans the fused parse out over threads
     (ShardedFusedBatches: interleaved sub-shard order, one padded tail
     per sub-shard).
     """
     uspec = URISpec(uri, part_index, num_parts)
     if format == "auto":
         format = str(uspec.args.get("format", "rowrec"))
-    check(format in ("rowrec", "libfm"),
-          f"ell_batches supports rowrec/libfm, not {format!r}")
+    check(format in ("rowrec", "libsvm", "libfm"),
+          f"ell_batches supports rowrec/libsvm/libfm, not {format!r}")
     fusable = (
         spec.layout == "ell"
         and spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16))
         and spec.index_dtype == np.dtype(np.int32)
         and spec.overflow == "truncate"
     )
+    if format == "libsvm":
+        if native.HAS_LIBSVM_ELL and fusable:
+            if nthread is not None and nthread > 1:
+                return ShardedFusedBatches(
+                    lambda t, n: FusedEllLibSVMBatches(
+                        uri, spec, part_index * n + t, num_parts * n,
+                        indexing_mode=indexing_mode, ring=ring,
+                    ),
+                    nthread,
+                )
+            return FusedEllLibSVMBatches(
+                uri, spec, part_index, num_parts,
+                indexing_mode=indexing_mode, ring=ring,
+            )
+        from ..data import create_parser
+        from .batcher import FixedShapeBatcher
+
+        if indexing_mode and "indexing_mode" not in uspec.args:
+            head, sep, frag = uri.partition("#")
+            head += ("&" if "?" in head else "?") + (
+                f"indexing_mode={indexing_mode}"
+            )
+            uri = head + sep + frag
+        parser = create_parser(
+            uri, part_index, num_parts, type="libsvm", nthread=nthread
+        )
+        return _GenericBatchStream(parser, FixedShapeBatcher(spec))
     if format == "libfm":
         if native.HAS_LIBFM_ELL and fusable:
             if nthread is not None and nthread > 1:

@@ -911,6 +911,91 @@ struct EllState {
   int64_t truncated;
 };
 
+// Per-row ELL writer shared by the text->ELL kernels (libsvm/libfm): the
+// store/truncate/finish rules must stay bit-identical across kernels —
+// they mirror FixedShapeBatcher._to_ell (staging/batcher.py) — so they
+// live here once instead of drifting per kernel.
+struct EllRowWriter {
+  EllState& st;
+  int32_t* irow;
+  uint16_t* vrow16;
+  float* vrow32;
+  uint64_t ubase;
+  int64_t k = 0;     // parsed-feature position within the row
+  int64_t kept = 0;  // features stored with a valid id
+
+  EllRowWriter(EllState& s, int64_t row, uint64_t base)
+      : st(s),
+        irow(s.indices + row * s.K),
+        vrow16(s.f16 ? static_cast<uint16_t*>(s.values) + row * s.K
+                     : nullptr),
+        vrow32(s.f16 ? nullptr
+                     : static_cast<float*>(s.values) + row * s.K),
+        ubase(base) {}
+
+  // first K parsed features keep token positions; ids outside int32
+  // after base subtraction (incl. 1-based wraparound of id 0) are
+  // zeroed in place + counted truncated; features beyond K dropped
+  // + counted
+  inline void store(int64_t feat, double v) {
+    if (k < st.K) {
+      const uint64_t col = static_cast<uint64_t>(feat) - ubase;
+      if (col > 0x7fffffffu) {
+        irow[k] = 0;
+        if (st.f16) vrow16[k] = 0; else vrow32[k] = 0.0f;
+        ++st.truncated;
+      } else {
+        irow[k] = static_cast<int32_t>(col);
+        if (st.f16) vrow16[k] = f32_to_f16(static_cast<float>(v));
+        else vrow32[k] = static_cast<float>(v);
+        ++kept;
+      }
+    } else {
+      ++st.truncated;
+    }
+    ++k;
+  }
+
+  // zero the unparsed tail and commit nnz = kept (holes stay positional)
+  inline void finish(int64_t row) {
+    const int64_t filled = k < st.K ? k : st.K;
+    std::memset(irow + filled, 0, static_cast<size_t>(st.K - filled) * 4);
+    if (st.f16) {
+      std::memset(vrow16 + filled, 0,
+                  static_cast<size_t>(st.K - filled) * 2);
+    } else {
+      std::memset(vrow32 + filled, 0,
+                  static_cast<size_t>(st.K - filled) * 4);
+    }
+    st.nnz[row] = static_cast<int32_t>(kept);
+  }
+};
+
+// Shared first-token scan: label or label:weight. Returns false (line
+// skipped) when the label token fails to parse; advances *pp past it.
+inline bool parse_label_token(const char** pp, const char* le, EllState& st,
+                              int64_t row) {
+  const char* p = *pp;
+  while (p < le && is_blank(*p)) ++p;
+  if (p >= le) return false;
+  const char* te = p;
+  while (te < le && !is_blank(*te)) ++te;
+  const char* colon =
+      static_cast<const char*>(memchr(p, ':', static_cast<size_t>(te - p)));
+  double lab, w = 1.0;
+  if (colon) {
+    if (!parse_float_full(p, colon, &lab) ||
+        !parse_float_full(colon + 1, te, &w))
+      return false;
+  } else if (!parse_float_full(p, te, &lab)) {
+    return false;
+  }
+  st.labels[row] = static_cast<float>(lab);
+  st.weights[row] = static_cast<float>(w);
+  *pp = te;
+  return true;
+}
+
 // Decode one rowrec payload into ELL row `row`. Returns false on a
 // malformed payload (declared sizes exceed the payload).
 inline bool rowrec_to_ell(const char* p, int64_t len, EllState& st,
@@ -1076,53 +1161,12 @@ DMLC_API void dmlc_parse_libfm_ell(
   const bool has_cr = walk_dense_lines(
       buf, len, row_start, row_capacity, cr_hint, out,
       [&](const char* lb, const char* le, int64_t row) {
-        // ---- label token: label or label:weight ----
         const char* p = lb;
-        while (p < le && is_blank(*p)) ++p;
-        if (p >= le) return false;
-        const char* te = p;
-        while (te < le && !is_blank(*te)) ++te;
-        {
-          const char* colon = static_cast<const char*>(
-              memchr(p, ':', static_cast<size_t>(te - p)));
-          double lab, w = 1.0;
-          if (colon) {
-            if (!parse_float_full(p, colon, &lab) ||
-                !parse_float_full(colon + 1, te, &w))
-              return false;
-          } else if (!parse_float_full(p, te, &lab)) {
-            return false;
-          }
-          st.labels[row] = static_cast<float>(lab);
-          st.weights[row] = static_cast<float>(w);
-        }
-        p = te;
+        if (!parse_label_token(&p, le, st, row)) return false;
 
-        int32_t* irow = st.indices + row * st.K;
-        uint16_t* vrow16 =
-            st.f16 ? static_cast<uint16_t*>(st.values) + row * st.K : nullptr;
-        float* vrow32 =
-            st.f16 ? nullptr : static_cast<float*>(st.values) + row * st.K;
-        int64_t k = 0;    // parsed-feature position within the row
-        int64_t kept = 0; // features stored with a valid id
-        const auto store = [&](int64_t feat, double v) {
-          if (k < st.K) {
-            const uint64_t col = static_cast<uint64_t>(feat) - ubase;
-            if (col > 0x7fffffffu) {
-              irow[k] = 0;
-              if (st.f16) vrow16[k] = 0; else vrow32[k] = 0.0f;
-              ++st.truncated;
-            } else {
-              irow[k] = static_cast<int32_t>(col);
-              if (st.f16) vrow16[k] = f32_to_f16(static_cast<float>(v));
-              else vrow32[k] = static_cast<float>(v);
-              ++kept;
-            }
-          } else {
-            ++st.truncated;
-          }
-          ++k;
-        };
+        EllRowWriter w(st, row, ubase);
+        const auto store = [&](int64_t feat, double v) { w.store(feat, v); };
+        const char* te;
         while (p < le) {
           while (p < le && is_blank(*p)) ++p;
           if (p >= le) break;
@@ -1190,17 +1234,110 @@ DMLC_API void dmlc_parse_libfm_ell(
           }
           p = te;
         }
-        const int64_t filled = k < st.K ? k : st.K;
-        std::memset(irow + filled, 0,
-                    static_cast<size_t>(st.K - filled) * 4);
-        if (st.f16) {
-          std::memset(vrow16 + filled, 0,
-                      static_cast<size_t>(st.K - filled) * 2);
-        } else {
-          std::memset(vrow32 + filled, 0,
-                      static_cast<size_t>(st.K - filled) * 4);
+        w.finish(row);
+        return true;
+      });
+  out->truncated = st.truncated;
+  out->has_cr = has_cr ? 1 : 0;
+}
+
+// -- fused libsvm -> fixed-shape ELL batch ------------------------------------
+//
+// Same resumable text-chunk contract as dmlc_parse_libsvm_dense (line walk,
+// cr_hint caching, stop at buffer-full/chunk-end) but ELL output; semantics
+// match LibSVMParser + FixedShapeBatcher('ell') composed (parity enforced
+// by tests/test_libsvm_ell.py) — the sparse layout the reference treats as
+// the premier text hot path (reference src/data/libsvm_parser.h:86-169):
+//   - '#' starts a comment (rest of line ignored);
+//   - a line is skipped iff its label token fails to parse
+//     (label or label:weight first token);
+//   - a second token 'qid:N' is consumed and discarded (the ELL device
+//     layout carries no qid, like the dense kernel);
+//   - feature tokens are index[:value]; a bare index is value 1.0;
+//     malformed tokens are skipped (strtonum tolerant rule);
+//   - the first max_nnz parsed features keep their token positions; ids
+//     that fall outside int32 after base subtraction (incl. 1-based
+//     wraparound of id 0) are zeroed in place and counted truncated;
+//     features beyond max_nnz are dropped and counted. Unlike the dense
+//     kernel there is no D bound and duplicates stay positional — ELL
+//     rows are gathered on device, not accumulated.
+// `base` is the resolved indexing base (callers resolve libsvm auto mode
+// against the file head, as the fused dense path does).
+
+DMLC_API void dmlc_parse_libsvm_ell(
+    const char* buf, int64_t len, int32_t base, int64_t max_nnz,
+    int32_t out_f16, int32_t* indices, void* values, int32_t* nnz,
+    float* labels, float* weights, int64_t row_start, int64_t row_capacity,
+    int32_t cr_hint, DenseResult* out) {
+  EllState st{indices, values, nnz, labels, weights, max_nnz, out_f16 != 0, 0};
+  const uint64_t ubase = static_cast<uint64_t>(base);
+  const bool has_cr = walk_dense_lines(
+      buf, len, row_start, row_capacity, cr_hint, out,
+      [&](const char* lb, const char* le, int64_t row) {
+        const void* hash = memchr(lb, '#', static_cast<size_t>(le - lb));
+        if (hash) le = static_cast<const char*>(hash);
+
+        const char* p = lb;
+        if (!parse_label_token(&p, le, st, row)) return false;
+
+        // ---- optional qid token (second token only; discarded) ----
+        while (p < le && is_blank(*p)) ++p;
+        {
+          const char* qe = p;
+          while (qe < le && !is_blank(*qe)) ++qe;
+          if (qe - p >= 4 && memcmp(p, "qid:", 4) == 0) p = qe;
         }
-        st.nnz[row] = static_cast<int32_t>(kept);
+
+        EllRowWriter w(st, row, ubase);
+        const auto store = [&](int64_t feat, double v) { w.store(feat, v); };
+        const char* te;
+        while (p < le) {
+          while (p < le && is_blank(*p)) ++p;
+          if (p >= le) break;
+          // ---- fast path: digits [':' value] in ONE forward pass ----
+          const char* q = p;
+          uint64_t feat = 0;
+          int fd = 0;
+          while (q < le && *q >= '0' && *q <= '9' && fd <= 18) {
+            feat = feat * 10 + static_cast<uint64_t>(*q - '0');
+            ++q;
+            ++fd;
+          }
+          if (fd > 0 && fd <= 18) {
+            if (q >= le || is_blank(*q)) {
+              store(static_cast<int64_t>(feat), 1.0);  // bare index
+              p = q;
+              continue;
+            }
+            if (*q == ':') {
+              ++q;
+              double v;
+              if (scan_decimal_value(&q, le, &v)) {
+                store(static_cast<int64_t>(feat), v);
+                p = q;
+                continue;
+              }
+            }
+          }
+          // ---- exact slow path over the full token (rare: exponents,
+          // signs, >18-digit ids, junk) ----
+          te = p;
+          while (te < le && !is_blank(*te)) ++te;
+          const char* colon = static_cast<const char*>(
+              memchr(p, ':', static_cast<size_t>(te - p)));
+          int64_t sfeat;
+          if (colon) {
+            double v;
+            if (parse_i64_full(p, colon, &sfeat) &&
+                parse_float_full(colon + 1, te, &v)) {
+              store(sfeat, v);
+            }
+          } else if (parse_i64_full(p, te, &sfeat)) {
+            store(sfeat, 1.0);
+          }
+          p = te;
+        }
+        w.finish(row);
         return true;
       });
   out->truncated = st.truncated;

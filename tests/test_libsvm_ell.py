@@ -1,0 +1,236 @@
+"""Fused libsvm→ELL kernel parity: native/fastparse.cc
+dmlc_parse_libsvm_ell vs LibSVMParser → FixedShapeBatcher('ell') composed
+(reference premier text hot path, src/data/libsvm_parser.h:86-169). The
+fused and generic batch streams must agree bit-for-bit on labels/weights/
+indices/values/nnz/truncation across dtypes, indexing modes, comments,
+qid tokens, junk, and sharding."""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.data import create_parser, native
+from dmlc_core_tpu.staging import BatchSpec, FixedShapeBatcher, ell_batches
+
+fused = pytest.mark.skipif(
+    not native.HAS_LIBSVM_ELL,
+    reason="native fused libsvm ELL kernel not built",
+)
+
+
+def _write_libsvm(path, rows=400, k_max=6, one_based=False, seed=0,
+                  junk=False, qid=False, comments=False):
+    rng = np.random.default_rng(seed)
+    lo = 1 if one_based else 0
+    lines = []
+    for i in range(rows):
+        k = int(rng.integers(1, k_max + 1))
+        toks = [f"{i % 2}" if i % 3 else f"{i % 2}:{0.5 + (i % 5)}"]
+        if qid and i % 2 == 0:
+            toks.append(f"qid:{i}")
+        for _ in range(k):
+            feat = int(rng.integers(lo, 5000))
+            if rng.random() < 0.6:
+                toks.append(f"{feat}:{rng.normal():.4f}")
+            else:
+                toks.append(f"{feat}")  # bare index: value 1.0
+        if junk and i % 7 == 0:
+            toks.append("noise")       # junk word: skipped
+            toks.append("a:b")         # malformed numbers: skipped
+            toks.append(":")           # empty halves: skipped
+        line = " ".join(toks)
+        if comments and i % 5 == 0:
+            line += " # trailing comment 9:9"
+        lines.append(line)
+    if junk:
+        lines.insert(5, "not_a_label 1:2")  # bad label: line skipped
+        lines.insert(9, "")                  # blank line
+    if comments:
+        lines.insert(3, "# whole-line comment")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def _spec(value_dtype="float32", B=64, K=4):
+    return BatchSpec(
+        batch_size=B, layout="ell", max_nnz=K,
+        value_dtype=np.dtype(value_dtype),
+    )
+
+
+def _generic(path, spec, part_index=0, num_parts=1, indexing_mode=0):
+    parser = create_parser(
+        f"{path}?indexing_mode={indexing_mode}", part_index, num_parts,
+        type="libsvm", threaded=False,
+    )
+    batcher = FixedShapeBatcher(spec)
+    out = list(batcher.batches(iter(parser)))
+    parser.close()
+    return out, batcher.truncated_nnz
+
+
+def _fused(path, spec, part_index=0, num_parts=1, indexing_mode=0):
+    from dmlc_core_tpu.staging import FusedEllLibSVMBatches
+
+    stream = FusedEllLibSVMBatches(
+        path, spec, part_index, num_parts, indexing_mode=indexing_mode
+    )
+    out = [
+        type(b)(
+            labels=b.labels.copy(), weights=b.weights.copy(),
+            n_valid=b.n_valid, indices=b.indices.copy(),
+            values=b.values.copy(), nnz=b.nnz.copy(),
+        )
+        for b in stream
+    ]
+    tr = stream.truncated_nnz
+    stream.close()
+    return out, tr
+
+
+def _assert_equal(fb, gb):
+    assert len(fb) == len(gb)
+    for f, g in zip(fb, gb):
+        assert f.n_valid == g.n_valid
+        np.testing.assert_array_equal(f.labels, g.labels)
+        np.testing.assert_array_equal(f.weights, g.weights)
+        np.testing.assert_array_equal(f.nnz, g.nnz)
+        np.testing.assert_array_equal(f.indices, g.indices)
+        np.testing.assert_array_equal(f.values, g.values)
+
+
+@fused
+@pytest.mark.parametrize("value_dtype", ["float32", "float16"])
+def test_fused_matches_generic(tmp_path, value_dtype):
+    path = _write_libsvm(str(tmp_path / "d.svm"), rows=500, k_max=7)
+    f, ft = _fused(path, _spec(value_dtype))
+    g, gt = _generic(path, _spec(value_dtype))
+    _assert_equal(f, g)
+    assert ft == gt and ft > 0  # k_max 7 > K=4 → truncation exercised
+
+
+@fused
+def test_fused_matches_generic_junk_qid_comments(tmp_path):
+    path = _write_libsvm(
+        str(tmp_path / "j.svm"), rows=300, junk=True, qid=True,
+        comments=True,
+    )
+    f, ft = _fused(path, _spec())
+    g, gt = _generic(path, _spec())
+    _assert_equal(f, g)
+    assert ft == gt
+
+
+@fused
+def test_one_based_indexing_modes(tmp_path):
+    path = _write_libsvm(str(tmp_path / "o.svm"), rows=200, one_based=True)
+    f, _ = _fused(path, _spec(), indexing_mode=1)
+    g, _ = _generic(path, _spec(), indexing_mode=1)
+    _assert_equal(f, g)
+    # auto mode resolves 1-based from the head probe = explicit mode 1
+    a, _ = _fused(path, _spec(), indexing_mode=-1)
+    _assert_equal(a, f)
+    # wrapped ids (0 under 1-based) are zeroed + counted, never negative
+    assert all(int(b.indices.min()) >= 0 for b in f)
+
+
+@fused
+def test_sharded_exact_cover(tmp_path):
+    path = _write_libsvm(str(tmp_path / "s.svm"), rows=400)
+    labels = []
+    for part in range(3):
+        batches, _ = _fused(path, _spec(B=32), part_index=part, num_parts=3)
+        for b in batches:
+            labels.extend(b.labels[: b.n_valid].tolist())
+    assert len(labels) == 400
+    full, _ = _generic(path, _spec(B=400))
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(labels)), np.sort(full[0].labels[:400])
+    )
+
+
+@fused
+def test_dispatcher_routes_libsvm(tmp_path):
+    from dmlc_core_tpu.staging import FusedEllLibSVMBatches
+    from dmlc_core_tpu.staging.fused import _GenericBatchStream
+
+    path = _write_libsvm(str(tmp_path / "r.svm"), rows=50)
+    s = ell_batches(path + "?format=libsvm", _spec())
+    assert isinstance(s, FusedEllLibSVMBatches)
+    total = sum(int(b.n_valid) for b in s)
+    s.close()
+    assert total == 50
+    # non-fusable spec falls back to the generic path, same totals
+    g = ell_batches(
+        path + "?format=libsvm",
+        BatchSpec(batch_size=64, layout="ell", max_nnz=4,
+                  index_dtype=np.dtype(np.int64)),
+    )
+    assert isinstance(g, _GenericBatchStream)
+    assert sum(int(b.n_valid) for b in g) == 50
+    g.close()
+
+
+@fused
+def test_threaded_fan_out_covers(tmp_path):
+    path = _write_libsvm(str(tmp_path / "t.svm"), rows=300)
+    s = ell_batches(path + "?format=libsvm", _spec(B=32), nthread=2)
+    labels = [x for b in s for x in b.labels[: b.n_valid].tolist()]
+    s.close()
+    assert len(labels) == 300
+
+
+@fused
+def test_fuzz_parity(tmp_path):
+    """Randomized noisy libsvm text stages identically through the fused
+    kernel and the generic path (the libsvm analogue of
+    tests/test_libfm_ell.py::test_fuzz_parity; runs under ASan via make
+    check)."""
+    rng = np.random.default_rng(31)
+    junk_pool = ["x", "a:b", "1:2:3", ":", "::", "-:-", "7:", ":9",
+                 "1:nan", "qid:zz", "  "]
+    for trial in range(12):
+        lines = []
+        for _ in range(60):
+            toks = []
+            r = rng.random()
+            if r < 0.15:
+                toks.append("junklabel")  # line dropped by both paths
+            elif r < 0.4:
+                toks.append(f"{rng.normal():.4g}:{abs(rng.normal()):.3g}")
+            else:
+                toks.append(f"{rng.normal():.4g}")
+            if rng.random() < 0.3:
+                toks.append(f"qid:{int(rng.integers(0, 99))}")
+            for _ in range(int(rng.integers(0, 9))):
+                if rng.random() < 0.25:
+                    toks.append(str(rng.choice(junk_pool)))
+                else:
+                    feat = int(rng.integers(-2, 3000))
+                    if rng.random() < 0.5:
+                        toks.append(f"{feat}:{rng.normal():.5g}")
+                    else:
+                        toks.append(f"{feat}")
+            line = " ".join(toks)
+            if rng.random() < 0.2:
+                line += " # comment 5:5"
+            lines.append(line)
+        eol = "\r\n" if trial % 3 == 0 else "\n"
+        path = str(tmp_path / f"fz{trial}.svm")
+        with open(path, "w", newline="") as f:
+            f.write(eol.join(lines) + eol)
+        for dtype in ("float32", "float16"):
+            f_b, f_t = _fused(path, _spec(dtype, B=37, K=4))
+            g_b, g_t = _generic(path, _spec(dtype, B=37, K=4))
+            _assert_equal(f_b, g_b)
+            assert f_t == g_t, (trial, dtype)
+
+
+def test_generic_fallback_without_native(tmp_path, monkeypatch):
+    """ell_batches format=libsvm works (same totals) when the kernel is
+    reported missing."""
+    path = _write_libsvm(str(tmp_path / "f.svm"), rows=80)
+    monkeypatch.setattr(native, "HAS_LIBSVM_ELL", False)
+    s = ell_batches(path + "?format=libsvm", _spec())
+    assert sum(int(b.n_valid) for b in s) == 80
+    s.close()
