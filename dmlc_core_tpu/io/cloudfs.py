@@ -12,8 +12,12 @@ TPU-native choices:
   buffer; the signing cost is per-connection, not per-byte)
 - ``hdfs://`` speaks WebHDFS REST instead of the JVM-bound libhdfs
   (hadoop clusters expose it by default; no JVM in the TPU host image)
-- ``gs://`` uses the GCS XML interop API with HMAC credentials — the same
-  signer as S3 pointed at storage.googleapis.com
+- ``gs://`` uses the GCS XML interop API with Application Default
+  Credentials — GCE/TPU-VM metadata-server OAuth tokens (the standard
+  auth on the target platform) or a GOOGLE_APPLICATION_CREDENTIALS
+  service-account JWT exchange — with HMAC interop keys
+  (GS_ACCESS_KEY_ID) as an explicit override, all over the same request
+  skeleton as S3
 - ``azure://`` supports SAS-token/public access (read+list); the reference
   itself ships Azure as a partial backend (azure_filesys.h:22-32)
 
@@ -23,11 +27,14 @@ hermetic tests point these clients at in-process fake servers.
 
 from __future__ import annotations
 
+import base64
 import datetime
 import hashlib
 import hmac
 import json
 import os
+import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -44,6 +51,9 @@ __all__ = [
     "HttpFileSystem",
     "SigV4Signer",
     "S3FileSystem",
+    "OAuthTokenProvider",
+    "MetadataServerToken",
+    "ServiceAccountToken",
     "GCSFileSystem",
     "WebHdfsFileSystem",
     "AzureBlobFileSystem",
@@ -586,23 +596,184 @@ class S3FileSystem(FileSystem):
                 return out
 
 
-class GCSFileSystem(S3FileSystem):
-    """gs:// via the GCS XML interop API — same wire protocol and signer
-    as S3 pointed at storage.googleapis.com with HMAC credentials
-    (GS_ACCESS_KEY_ID / GS_SECRET_ACCESS_KEY, endpoint override
-    GCS_ENDPOINT). The SURVEY §7.2 'GCS client with the same curl+TLS
-    skeleton' in stdlib form."""
+# -- GCS OAuth (Application Default Credentials) -----------------------------
 
-    protocol = "gs://"
+
+class OAuthTokenProvider:
+    """Cached OAuth2 access token, refreshed ahead of expiry.
+
+    Thread-safe: fused producers fan out over threads and all share the
+    singleton filesystem instance."""
+
+    _MARGIN = 120.0  # refresh this many seconds before expiry
+
+    def __init__(self) -> None:
+        self._token: Optional[str] = None
+        self._refresh_at = 0.0  # soft deadline: refresh past this
+        self._expiry = 0.0      # hard deadline: token invalid past this
+        self._lock = threading.Lock()
+
+    def token(self) -> str:
+        with self._lock:
+            now = time.time()
+            if self._token is not None and now < self._refresh_at:
+                return self._token
+            try:
+                tok, ttl = self._fetch()
+            except (OSError, Error, KeyError, ValueError):
+                # transient fetch failure: a still-valid token (we refresh
+                # _MARGIN early) must keep the job alive rather than
+                # downgrading a mid-run refresh hiccup into hard failure
+                if self._token is not None and now < self._expiry:
+                    return self._token
+                raise
+            ttl = max(float(ttl), 0.0)
+            self._token = tok
+            now = time.time()
+            # short-lived answers (metadata servers count expires_in
+            # down) are still reused for half their life instead of
+            # refetching per request once ttl < margin
+            soft = ttl - self._MARGIN if ttl > 2 * self._MARGIN else ttl / 2
+            self._refresh_at = now + soft
+            self._expiry = now + ttl
+            return self._token
+
+    def _fetch(self) -> Tuple[str, float]:
+        raise NotImplementedError
+
+
+class MetadataServerToken(OAuthTokenProvider):
+    """GCE/TPU-VM instance token from the metadata server — the default
+    credential on the platform this framework targets (HMAC interop keys,
+    the r3 approach, are a legacy opt-in most orgs disable). Host
+    overridable via GCE_METADATA_HOST (also the hermetic-test hook)."""
 
     def __init__(self) -> None:
         super().__init__()
-        self.access_key = os.environ.get(
-            "GS_ACCESS_KEY_ID", self.access_key
+        host = os.environ.get("GCE_METADATA_HOST", "metadata.google.internal")
+        self.url = (
+            f"http://{host}/computeMetadata/v1/instance/"
+            "service-accounts/default/token"
         )
-        self.secret_key = os.environ.get(
-            "GS_SECRET_ACCESS_KEY", self.secret_key
+
+    def _fetch(self) -> Tuple[str, float]:
+        resp = _request(
+            self.url, headers={"Metadata-Flavor": "Google"}, timeout=2.0
         )
+        try:
+            body = json.loads(resp.read())
+        finally:
+            resp.close()
+        return body["access_token"], float(body.get("expires_in", 300))
+
+
+class ServiceAccountToken(OAuthTokenProvider):
+    """GOOGLE_APPLICATION_CREDENTIALS service-account key → RS256 JWT →
+    access token (the OAuth2 jwt-bearer grant). Token endpoint
+    overridable for tests via GCS_TOKEN_URI."""
+
+    SCOPE = "https://www.googleapis.com/auth/devstorage.read_write"
+
+    def __init__(self, path: str) -> None:
+        super().__init__()
+        try:
+            with open(path) as f:
+                info = json.load(f)
+        except (OSError, ValueError) as e:
+            raise Error(
+                f"bad GOOGLE_APPLICATION_CREDENTIALS file {path!r}: {e}"
+            ) from e
+        check(
+            info.get("type") == "service_account",
+            f"{path}: not a service_account key (type={info.get('type')!r})",
+        )
+        check(
+            "client_email" in info and "private_key" in info,
+            f"{path}: service_account key missing client_email/private_key",
+        )
+        self.email = info["client_email"]
+        self.private_key_pem = info["private_key"].encode()
+        self.token_uri = os.environ.get(
+            "GCS_TOKEN_URI", info.get("token_uri",
+                                      "https://oauth2.googleapis.com/token")
+        )
+
+    @staticmethod
+    def _b64(data: bytes) -> bytes:
+        return base64.urlsafe_b64encode(data).rstrip(b"=")
+
+    def _jwt(self, now: float) -> bytes:
+        try:
+            from cryptography.hazmat.primitives import hashes, serialization
+            from cryptography.hazmat.primitives.asymmetric import padding
+        except ImportError as e:  # pragma: no cover - baked into the image
+            raise Error(
+                "service-account gs:// auth needs the 'cryptography' "
+                "package for RS256 signing; use HMAC interop keys "
+                "(GS_ACCESS_KEY_ID) or metadata-server credentials instead"
+            ) from e
+        header = self._b64(json.dumps({"alg": "RS256", "typ": "JWT"}).encode())
+        claims = self._b64(json.dumps({
+            "iss": self.email,
+            "scope": self.SCOPE,
+            "aud": self.token_uri,
+            "iat": int(now),
+            "exp": int(now) + 3600,
+        }).encode())
+        signing_input = header + b"." + claims
+        key = serialization.load_pem_private_key(
+            self.private_key_pem, password=None
+        )
+        sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
+        return signing_input + b"." + self._b64(sig)
+
+    def _fetch(self) -> Tuple[str, float]:
+        payload = urllib.parse.urlencode({
+            "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
+            "assertion": self._jwt(time.time()).decode(),
+        }).encode()
+        resp = _request(
+            self.token_uri, "POST", {
+                "Content-Type": "application/x-www-form-urlencoded",
+            }, payload,
+        )
+        try:
+            body = json.loads(resp.read())
+        finally:
+            resp.close()
+        return body["access_token"], float(body.get("expires_in", 3600))
+
+
+class GCSFileSystem(S3FileSystem):
+    """gs:// via the GCS XML API with Application Default Credentials.
+
+    Credential resolution (the ADC order, on the stdlib HTTP client):
+
+    1. HMAC interop keys (GS_ACCESS_KEY_ID / GS_SECRET_ACCESS_KEY) →
+       SigV4, the S3-compatible legacy path — explicit override;
+    2. GOOGLE_APPLICATION_CREDENTIALS service-account JSON → RS256 JWT
+       exchanged for an OAuth token;
+    3. GCE/TPU-VM metadata server → instance OAuth token (the default
+       on the target platform); probed lazily, failure cached, so
+       non-GCE hosts fall through to
+    4. anonymous (public buckets).
+
+    Endpoint override GCS_ENDPOINT (also the hermetic-test hook).
+    NO_GCE_CHECK=1 skips the metadata probe (google-auth convention).
+    """
+
+    protocol = "gs://"
+
+    _PROBE_RETRY = 60.0  # seconds between metadata probes after a failure
+
+    def __init__(self) -> None:
+        super().__init__()
+        # GS_* ONLY — inheriting the AWS/S3 env creds here would SigV4-
+        # sign gs:// requests with AWS keys on any host that also talks
+        # to s3://, shadowing working ADC credentials with guaranteed
+        # 403s
+        self.access_key = os.environ.get("GS_ACCESS_KEY_ID", "")
+        self.secret_key = os.environ.get("GS_SECRET_ACCESS_KEY", "")
         # GCS_ENDPOINT only — falling back to S3_ENDPOINT would silently
         # route gs:// traffic to an S3-targeting override
         self.endpoint = os.environ.get(
@@ -616,6 +787,41 @@ class GCSFileSystem(S3FileSystem):
             if self.access_key
             else None
         )
+        self._oauth: Optional[OAuthTokenProvider] = None
+        self._probe_fail_until = 0.0
+        if self.signer is None:
+            sa_path = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS")
+            if sa_path:
+                self._oauth = ServiceAccountToken(sa_path)
+            elif os.environ.get("NO_GCE_CHECK", "0") != "1":
+                self._oauth = MetadataServerToken()
+
+    @property
+    def _oauth_failed(self) -> bool:
+        """True while inside the post-failure probe backoff window."""
+        return time.time() < self._probe_fail_until
+
+    def _signed_headers(
+        self, method: str, url: str, headers: Dict[str, str], payload: bytes
+    ) -> Dict[str, str]:
+        if self.signer is not None:
+            return super()._signed_headers(method, url, headers, payload)
+        if self._oauth is not None and not self._oauth_failed:
+            try:
+                token = self._oauth.token()
+            except (OSError, Error, KeyError, ValueError):
+                if isinstance(self._oauth, MetadataServerToken):
+                    # no reachable metadata server: back off to anonymous
+                    # for a window, then re-probe — NOT latched forever,
+                    # or one transient timeout on a real TPU VM would
+                    # silently downgrade a private-bucket job to 401s
+                    self._probe_fail_until = time.time() + self._PROBE_RETRY
+                    return headers
+                raise  # explicit service-account config must fail loudly
+            out = dict(headers)
+            out["Authorization"] = f"Bearer {token}"
+            return out
+        return headers
 
 
 # -- WebHDFS -----------------------------------------------------------------

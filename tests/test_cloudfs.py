@@ -502,6 +502,289 @@ def test_gcs_uses_same_wire(s3, monkeypatch):
     r.close()
 
 
+# -- gs:// ADC (metadata server / service-account JWT) -----------------------
+
+
+class FakeMetadataHandler(BaseHTTPRequestHandler):
+    """GCE metadata server: /computeMetadata/v1/.../token with the
+    mandatory Metadata-Flavor header."""
+
+    TOKEN = "meta-token-1"
+    EXPIRES_IN = 3600
+    CALLS = 0
+
+    def log_message(self, *a):
+        pass
+
+    def do_GET(self):
+        type(self).CALLS += 1
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_error(403, "missing Metadata-Flavor")
+            return
+        body = json.dumps({
+            "access_token": self.TOKEN,
+            "expires_in": self.EXPIRES_IN,
+            "token_type": "Bearer",
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class FakeGcsBearerHandler(BaseHTTPRequestHandler):
+    """GCS XML API accepting ONLY Bearer auth (no SigV4): GET/HEAD
+    objects from STORE; records the Authorization headers seen."""
+
+    STORE = {}
+    EXPECT_TOKEN = "meta-token-1"
+    SAW_AUTH = []
+    ALLOW_ANON = False
+
+    def log_message(self, *a):
+        pass
+
+    def _key(self):
+        return urllib.parse.unquote(self.path.split("?", 1)[0].lstrip("/"))
+
+    def _authed(self):
+        auth = self.headers.get("Authorization", "")
+        type(self).SAW_AUTH.append(auth)
+        if self.ALLOW_ANON and not auth:
+            return True
+        if auth != f"Bearer {self.EXPECT_TOKEN}":
+            self.send_error(401, "bad bearer")
+            return False
+        return True
+
+    def do_HEAD(self):
+        if not self._authed():
+            return
+        data = self.STORE.get(self._key())
+        if data is None:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._authed():
+            return
+        data = self.STORE.get(self._key())
+        if data is None:
+            self.send_error(404)
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            a, b = _range_bounds(rng, len(data))
+            chunk = data[a:b + 1]
+            self.send_response(206)
+        else:
+            chunk = data
+            self.send_response(200)
+        self.send_header("Content-Length", str(len(chunk)))
+        self.end_headers()
+        self.wfile.write(chunk)
+
+
+@pytest.fixture
+def gcs_adc(monkeypatch):
+    """Fake metadata server + Bearer-only GCS endpoint; no HMAC keys."""
+    for var in ("GS_ACCESS_KEY_ID", "GS_SECRET_ACCESS_KEY",
+                "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                "S3_ACCESS_KEY", "S3_SECRET_KEY",
+                "GOOGLE_APPLICATION_CREDENTIALS"):
+        monkeypatch.delenv(var, raising=False)
+    FakeMetadataHandler.CALLS = 0
+    FakeMetadataHandler.EXPIRES_IN = 3600
+    FakeGcsBearerHandler.STORE = {}
+    FakeGcsBearerHandler.SAW_AUTH = []
+    FakeGcsBearerHandler.ALLOW_ANON = False
+    meta = _Server(FakeMetadataHandler)
+    gcs = _Server(FakeGcsBearerHandler)
+    monkeypatch.setenv("GCE_METADATA_HOST", f"127.0.0.1:{meta.port}")
+    monkeypatch.setenv("GCS_ENDPOINT", gcs.url)
+    reset_singletons()
+    yield meta, gcs
+    reset_singletons()
+    meta.stop()
+    gcs.stop()
+
+
+def test_gcs_metadata_server_token(gcs_adc):
+    meta, gcs = gcs_adc
+    FakeGcsBearerHandler.STORE["bkt/data.txt"] = b"adc-bytes"
+    fs = FileSystem.get_instance("gs://bkt/data.txt")
+    assert isinstance(fs, GCSFileSystem) and fs.signer is None
+    r = fs.open("gs://bkt/data.txt", "r")
+    assert r.read() == b"adc-bytes"
+    r.close()
+    assert all(
+        a == "Bearer meta-token-1" for a in FakeGcsBearerHandler.SAW_AUTH
+    )
+    # token is cached across requests: one metadata fetch, many GETs
+    fs.get_path_info("gs://bkt/data.txt")
+    assert FakeMetadataHandler.CALLS == 1
+
+
+def test_gcs_metadata_token_refresh_deadlines(gcs_adc):
+    import time as time_mod
+
+    from dmlc_core_tpu.io.cloudfs import MetadataServerToken
+
+    # a short-lived token is still reused for half its life (no
+    # per-request refetch storm when expires_in counts below the margin)
+    FakeMetadataHandler.EXPIRES_IN = 1
+    tok = MetadataServerToken()
+    assert tok.token() == "meta-token-1"
+    assert tok.token() == "meta-token-1"
+    assert FakeMetadataHandler.CALLS == 1
+    time_mod.sleep(0.6)  # past the soft deadline (ttl/2)
+    assert tok.token() == "meta-token-1"
+    assert FakeMetadataHandler.CALLS == 2
+
+
+def test_gcs_stale_token_survives_refresh_hiccup(gcs_adc):
+    """A mid-run metadata-server failure must serve the still-valid
+    cached token (we refresh early), not kill the job."""
+    meta, _ = gcs_adc
+    from dmlc_core_tpu.io.cloudfs import MetadataServerToken
+
+    FakeMetadataHandler.EXPIRES_IN = 3600
+    tok = MetadataServerToken()
+    assert tok.token() == "meta-token-1"
+    meta.stop()  # metadata server goes away mid-run
+    tok._refresh_at = 0.0  # force a refresh attempt
+    assert tok.token() == "meta-token-1"  # stale-but-valid wins
+
+
+def test_gcs_falls_back_anonymous_off_gce(monkeypatch):
+    """No creds + unreachable metadata server → anonymous requests (public
+    buckets), with the failed probe cached, not retried per request."""
+    for var in ("GS_ACCESS_KEY_ID", "GS_SECRET_ACCESS_KEY",
+                "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                "S3_ACCESS_KEY", "S3_SECRET_KEY",
+                "GOOGLE_APPLICATION_CREDENTIALS"):
+        monkeypatch.delenv(var, raising=False)
+    FakeGcsBearerHandler.STORE = {"pub/obj": b"public"}
+    FakeGcsBearerHandler.SAW_AUTH = []
+    FakeGcsBearerHandler.ALLOW_ANON = True
+    gcs = _Server(FakeGcsBearerHandler)
+    # a dead port: connection refused, fast
+    monkeypatch.setenv("GCE_METADATA_HOST", "127.0.0.1:9")
+    monkeypatch.setenv("GCS_ENDPOINT", gcs.url)
+    reset_singletons()
+    try:
+        fs = FileSystem.get_instance("gs://pub/obj")
+        r = fs.open("gs://pub/obj", "r")
+        assert r.read() == b"public"
+        r.close()
+        assert fs._oauth_failed  # probe failure cached
+        assert FakeGcsBearerHandler.SAW_AUTH[-1] == ""
+    finally:
+        reset_singletons()
+        gcs.stop()
+
+
+class FakeTokenEndpointHandler(BaseHTTPRequestHandler):
+    """OAuth2 token endpoint verifying the RS256 jwt-bearer assertion
+    against the test keypair before minting a token."""
+
+    PUBLIC_KEY = None  # set by the test
+    TOKEN = "sa-token-9"
+    LAST_CLAIMS = None
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):
+        import base64 as b64mod
+
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        n = int(self.headers.get("Content-Length", "0"))
+        form = urllib.parse.parse_qs(self.rfile.read(n).decode())
+        assert form["grant_type"] == [
+            "urn:ietf:params:oauth:grant-type:jwt-bearer"
+        ]
+        jwt = form["assertion"][0]
+        signing_input, sig_b64 = jwt.rsplit(".", 1)
+        pad = "=" * (-len(sig_b64) % 4)
+        sig = b64mod.urlsafe_b64decode(sig_b64 + pad)
+        # raises InvalidSignature → 500 → test fails, which is the point
+        self.PUBLIC_KEY.verify(
+            sig, signing_input.encode(), padding.PKCS1v15(), hashes.SHA256()
+        )
+        claims_b64 = signing_input.split(".")[1]
+        pad = "=" * (-len(claims_b64) % 4)
+        type(self).LAST_CLAIMS = json.loads(
+            b64mod.urlsafe_b64decode(claims_b64 + pad)
+        )
+        body = json.dumps({
+            "access_token": self.TOKEN,
+            "expires_in": 3600,
+            "token_type": "Bearer",
+        }).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def test_gcs_service_account_jwt(tmp_path, monkeypatch):
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+    sa = {
+        "type": "service_account",
+        "client_email": "svc@proj.iam.gserviceaccount.com",
+        "private_key": pem,
+        "token_uri": "http://unused.invalid/token",
+    }
+    sa_path = tmp_path / "sa.json"
+    sa_path.write_text(json.dumps(sa))
+
+    FakeTokenEndpointHandler.PUBLIC_KEY = key.public_key()
+    FakeTokenEndpointHandler.LAST_CLAIMS = None
+    tok_srv = _Server(FakeTokenEndpointHandler)
+    FakeGcsBearerHandler.STORE = {"b/k": b"sa-bytes"}
+    FakeGcsBearerHandler.SAW_AUTH = []
+    FakeGcsBearerHandler.ALLOW_ANON = False
+    FakeGcsBearerHandler.EXPECT_TOKEN = "sa-token-9"
+    gcs = _Server(FakeGcsBearerHandler)
+    for var in ("GS_ACCESS_KEY_ID", "GS_SECRET_ACCESS_KEY",
+                "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
+                "S3_ACCESS_KEY", "S3_SECRET_KEY"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS", str(sa_path))
+    monkeypatch.setenv("GCS_TOKEN_URI", f"{tok_srv.url}/token")
+    monkeypatch.setenv("GCS_ENDPOINT", gcs.url)
+    reset_singletons()
+    try:
+        fs = FileSystem.get_instance("gs://b/k")
+        r = fs.open("gs://b/k", "r")
+        assert r.read() == b"sa-bytes"
+        r.close()
+        claims = FakeTokenEndpointHandler.LAST_CLAIMS
+        assert claims["iss"] == "svc@proj.iam.gserviceaccount.com"
+        assert claims["aud"] == f"{tok_srv.url}/token"
+        assert claims["exp"] - claims["iat"] == 3600
+        assert "devstorage" in claims["scope"]
+    finally:
+        reset_singletons()
+        FakeGcsBearerHandler.EXPECT_TOKEN = "meta-token-1"
+        tok_srv.stop()
+        gcs.stop()
+
+
 # -- webhdfs -----------------------------------------------------------------
 
 @pytest.fixture
