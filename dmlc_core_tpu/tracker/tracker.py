@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import queue
 import socket
 import subprocess
 import threading
@@ -80,6 +81,7 @@ class WorkerEntry:
         self.cmd = self.sock.recv_str()
         self.wait_accept = 0
         self.port: Optional[int] = None
+        self.print_msg: Optional[str] = None  # filled for cmd == 'print'
 
     def decide_rank(self, job_map: Dict[str, int]) -> int:
         if self.rank >= 0:
@@ -95,10 +97,17 @@ class WorkerEntry:
         tree_map: Dict[int, List[int]],
         parent_map: Dict[int, int],
         ring_map: Dict[int, Tuple[int, int]],
+        lock: Optional[threading.Lock] = None,
     ) -> List[int]:
         """Send rank/topology, then broker peer connections until this
         worker has wired every missing link (reference assign_rank,
-        tracker.py:80-135)."""
+        tracker.py:80-135).
+
+        ``lock`` guards wait_conn when sessions run concurrently
+        (_BrokerPool): two non-adjacent sessions sharing a neighbor both
+        read its endpoint and decrement its wait_accept. Snapshots are
+        taken under the lock; client I/O happens outside it."""
+        guard = lock if lock is not None else threading.Lock()
         self.rank = rank
         nnset: Set[int] = set(tree_map[rank])
         rprev, rnext = ring_map[rank]
@@ -121,8 +130,8 @@ class WorkerEntry:
         while True:
             ngood = self.sock.recv_int()
             # client-controlled count: bound BEFORE reading, or a hostile
-            # client feeds an unbounded int stream into the single-threaded
-            # accept loop
+            # client feeds an unbounded int stream into the brokering
+            # session
             if not 0 <= ngood <= len(nnset):
                 raise ProtocolError(
                     f"rank {rank} reported {ngood} good links; neighbor "
@@ -137,30 +146,141 @@ class WorkerEntry:
                     f"outside its neighbor set {sorted(nnset)}"
                 )
             badset = nnset - goodset
-            conset = [r for r in badset if r in wait_conn]
+            with guard:
+                conset = [
+                    (r, wait_conn[r].host, wait_conn[r].port)
+                    for r in badset
+                    if r in wait_conn
+                ]
             self.sock.send_int(len(conset))
             self.sock.send_int(len(badset) - len(conset))
-            for r in conset:
-                self.sock.send_str(wait_conn[r].host)
-                self.sock.send_int(wait_conn[r].port)  # type: ignore[arg-type]
+            for r, host, port in conset:
+                self.sock.send_str(host)
+                self.sock.send_int(port)  # type: ignore[arg-type]
                 self.sock.send_int(r)
             nerr = self.sock.recv_int()
             if nerr != 0:
                 continue
             self.port = self.sock.recv_int()
             done: List[int] = []
-            for r in conset:
-                wait_conn[r].wait_accept -= 1
-                if wait_conn[r].wait_accept == 0:
-                    done.append(r)
-            for r in done:
-                wait_conn.pop(r, None)
+            with guard:
+                for r, _host, _port in conset:
+                    peer = wait_conn.get(r)
+                    if peer is None:
+                        continue
+                    peer.wait_accept -= 1
+                    if peer.wait_accept == 0:
+                        done.append(r)
+                for r in done:
+                    wait_conn.pop(r, None)
             self.wait_accept = len(badset) - len(conset)
             return done
 
 
+class _BrokerPool:
+    """Concurrent assign_rank sessions, serialized per neighborhood.
+
+    The r3 tracker brokered one ``assign_rank`` exchange at a time on the
+    accept thread, so one slow-but-alive client stalled every other
+    worker for up to client_timeout per recv. Sessions are multi-round
+    client exchanges, so full parallelism is tempting — but unsafe: for
+    neighbors A and B, exactly one of (A connects to B) / (B connects to
+    A) must happen, which the protocol decides by "was the peer already
+    registered in wait_conn when I queried?". Two neighbors brokering
+    concurrently can BOTH miss each other and deadlock waiting for the
+    other to dial in.
+
+    So: a session for rank r waits while any ACTIVE session belongs to a
+    rank adjacent to r (tree link or ring prev/next) — the miss-each-
+    other race exists only between direct neighbors. Everyone else
+    brokers fully in parallel (shared-peer wait_conn mutations are
+    guarded by ``lock``): a stalling client delays only its 3-4 topology
+    neighbors, not the pod. Registration into wait_conn happens INSIDE
+    the session thread before the reservation is released, preserving
+    the serial tracker's happens-before for neighbor pairs.
+    """
+
+    def __init__(self, events: "queue.Queue", wait_conn, tree_map,
+                 parent_map, ring_map) -> None:
+        self._events = events
+        self._wait_conn = wait_conn
+        self._maps = (tree_map, parent_map, ring_map)
+        self._lock = threading.Lock()
+        self._active: Dict[int, Set[int]] = {}  # rank -> closed nbr set
+        self._queued: List[Tuple["WorkerEntry", int]] = []
+
+    def _closed_set(self, rank: int) -> Set[int]:
+        tree_map, _, ring_map = self._maps
+        nbrs = set(tree_map[rank]) | {rank}
+        rprev, rnext = ring_map[rank]
+        if rprev != -1:
+            nbrs.add(rprev)
+        if rnext != -1:
+            nbrs.add(rnext)
+        return nbrs
+
+    def submit(self, entry: "WorkerEntry", rank: int) -> None:
+        with self._lock:
+            self._queued.append((entry, rank))
+            self._pump()
+
+    def idle(self) -> bool:
+        with self._lock:
+            return not self._active and not self._queued
+
+    def _pump(self) -> None:
+        """Start every queued session not adjacent to an active one.
+        Caller holds the lock."""
+        still: List[Tuple["WorkerEntry", int]] = []
+        for entry, rank in self._queued:
+            # conflict iff rank is in an active session's closed set
+            # (adjacency is symmetric: rank ∈ closed(s) ⇔ s ∈ closed(rank))
+            if any(rank in act for act in self._active.values()):
+                still.append((entry, rank))
+                continue
+            self._active[rank] = self._closed_set(rank)
+            threading.Thread(
+                target=self._run, args=(entry, rank), daemon=True,
+                name=f"rabit-broker-{rank}",
+            ).start()
+        self._queued = still
+
+    def _run(self, entry: "WorkerEntry", rank: int) -> None:
+        tree_map, parent_map, ring_map = self._maps
+        try:
+            entry.assign_rank(
+                rank, self._wait_conn, tree_map, parent_map, ring_map,
+                lock=self._lock,
+            )
+        except (ProtocolError, ConnectionError, OSError) as e:
+            entry.sock.close()
+            with self._lock:
+                del self._active[rank]
+                self._pump()
+            self._events.put(("assign_failed", entry, rank, e))
+            return
+        with self._lock:
+            # register BEFORE releasing the neighborhood: a neighbor's
+            # session must observe this worker in wait_conn
+            if entry.wait_accept > 0:
+                self._wait_conn[rank] = entry
+            del self._active[rank]
+            self._pump()
+        self._events.put(("assigned", entry, rank, None))
+
+
 class RabitTracker:
-    """Rendezvous server (reference RabitTracker, tracker.py:137-334)."""
+    """Rendezvous server (reference RabitTracker, tracker.py:137-334).
+
+    Three thread roles (the reference runs everything on one thread and
+    stalls the job on one slow client):
+    - accept thread: ``accept()`` + one short-lived handshake thread per
+      connection (a slow-loris handshake occupies only its own thread);
+    - state thread: the rendezvous state machine, fed by a queue of
+      handshake-complete and session-complete events — sole owner of
+      job_map/todo_nodes/pending/shutdown;
+    - broker sessions: _BrokerPool above.
+    """
 
     def __init__(
         self,
@@ -198,6 +318,8 @@ class RabitTracker:
         self.port = bound
         self.n_workers = n_workers
         self.thread: Optional[threading.Thread] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._events: "queue.Queue" = queue.Queue()
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         self.messages: List[str] = []  # relayed worker 'print' logs
@@ -211,34 +333,134 @@ class RabitTracker:
             "DMLC_TRACKER_PORT": self.port,
         }
 
-    # -- accept loop ---------------------------------------------------------
+    # -- accept + handshake threads ------------------------------------------
+    def _accept_loop(self) -> None:
+        """accept() and hand each connection to its own handshake thread.
+        Exits when the listening socket is closed."""
+        while True:
+            try:
+                conn, addr = self.sock.accept()
+            except OSError:
+                return  # socket closed (tracker.close())
+            conn.settimeout(self.client_timeout)
+            threading.Thread(
+                target=self._handshake, args=(conn, addr), daemon=True,
+                name="rabit-handshake",
+            ).start()
+
+    def _handshake(self, conn: socket.socket, addr: Tuple) -> None:
+        """Blocking WorkerEntry construction off the state thread: a
+        slow-loris client burns only this thread's timeout."""
+        try:
+            entry = WorkerEntry(conn, addr)
+            if entry.cmd == "print":
+                # read the relayed message here too — it is the other
+                # blocking recv a hostile client could stall on
+                entry.print_msg = entry.sock.recv_str()
+        except (ConnectionError, OSError) as e:
+            logger.warning("bad handshake: %s", e)
+            conn.close()
+            return
+        self._events.put(("entry", entry, None, None))
+
+    # -- state machine --------------------------------------------------------
     def _accept_workers(self, n_workers: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
         wait_conn: Dict[int, WorkerEntry] = {}
         job_map: Dict[str, int] = {}
         pending: List[WorkerEntry] = []
         todo_nodes: List[int] = []
+        deferred_shutdown: List[WorkerEntry] = []
+        inflight: Dict[int, str] = {}  # rank → jobid, session running
+        started: Set[int] = set()      # ranks whose assignment COMPLETED
         tree_map = parent_map = ring_map = None
+        broker: Optional[_BrokerPool] = None
 
         def check_proto(ok: bool, why: str) -> None:
             if not ok:
                 raise ProtocolError(why)
 
+        def flush_deferred() -> None:
+            """Shutdowns that arrived while their wait_conn entry was
+            still pending a concurrent session's decrement: accept once
+            the entry clears; reject only when no in-flight session can
+            ever clear it (a genuine protocol violation). The serial
+            tracker never saw this race — the shutdown connection sat in
+            the listen backlog behind the brokering exchange."""
+            still: List[WorkerEntry] = []
+            for d in deferred_shutdown:
+                if d.rank in shutdown:
+                    logger.warning(
+                        "protocol error from %s: duplicate shutdown from "
+                        "rank %d — dropping connection", d.host, d.rank,
+                    )
+                    d.sock.close()
+                    continue
+                if d.rank in wait_conn:
+                    if broker is not None and not broker.idle():
+                        still.append(d)
+                        continue
+                    logger.warning(
+                        "protocol error from %s: shutdown from rank %d "
+                        "still wiring peers — dropping connection",
+                        d.host, d.rank,
+                    )
+                    d.sock.close()
+                    continue
+                shutdown[d.rank] = d
+                logger.debug("shutdown signal from %d (deferred)", d.rank)
+            deferred_shutdown[:] = still
+
+        def submit(entry: WorkerEntry, rank: int) -> None:
+            # reserve the rank at submit time (failure returns it via the
+            # assign_failed event), mirroring the serial tracker's
+            # remove-on-assignment; inflight carries the ownership the
+            # serial tracker got for free from synchronous assignment
+            if rank in todo_nodes:
+                todo_nodes.remove(rank)
+            inflight[rank] = entry.jobid
+            broker.submit(entry, rank)
+
         while len(shutdown) != n_workers:
-            conn, addr = self.sock.accept()
-            conn.settimeout(self.client_timeout)
             try:
-                entry = WorkerEntry(conn, addr)
-            except (ConnectionError, OSError) as e:
-                logger.warning("bad handshake: %s", e)
-                conn.close()
+                kind, entry, rank_done, err = self._events.get(timeout=0.5)
+            except queue.Empty:
+                flush_deferred()  # broker may have drained meanwhile
+                continue
+            flush_deferred()
+            if kind == "stop":
+                logger.info("@tracker stopped before job completion")
+                return
+            if kind == "assign_failed":
+                logger.warning(
+                    "assigning rank %d to %s failed: %s — rank returned "
+                    "to pool",
+                    rank_done, entry.host, err,
+                )
+                inflight.pop(rank_done, None)
+                todo_nodes.insert(0, rank_done)
+                continue
+            if kind == "assigned":
+                inflight.pop(rank_done, None)
+                started.add(rank_done)
+                if entry.jobid != "NULL":
+                    job_map[entry.jobid] = rank_done
+                logger.debug(
+                    "%s from %s; assigned rank %d",
+                    entry.cmd, entry.host, rank_done,
+                )
+                if len(started) == n_workers and self.start_time is None:
+                    logger.info(
+                        "@tracker all of %d nodes are started", n_workers
+                    )
+                    self.start_time = time.time()
                 continue
             # Any protocol violation (or a socket dying mid-exchange) drops
-            # THIS connection; the accept loop must keep serving the rest of
-            # the job (VERDICT r1 weak #8 — the reference dies here).
+            # THIS connection; the state machine must keep serving the rest
+            # of the job (VERDICT r1 weak #8 — the reference dies here).
             try:
                 if entry.cmd == "print":
-                    msg = entry.sock.recv_str()
+                    msg = entry.print_msg or ""
                     self.messages.append(msg.strip())
                     logger.info("%s", msg.strip())
                     continue
@@ -251,10 +473,11 @@ class RabitTracker:
                         entry.rank not in shutdown,
                         f"duplicate shutdown from rank {entry.rank}",
                     )
-                    check_proto(
-                        entry.rank not in wait_conn,
-                        f"shutdown from rank {entry.rank} still wiring peers",
-                    )
+                    if entry.rank in wait_conn:
+                        # a concurrent session may not have applied its
+                        # wait_conn decrement yet — defer, don't reject
+                        deferred_shutdown.append(entry)
+                        continue
                     shutdown[entry.rank] = entry
                     logger.debug("shutdown signal from %d", entry.rank)
                     continue
@@ -272,6 +495,10 @@ class RabitTracker:
                         self.n_workers = n_workers
                     tree_map, parent_map, ring_map = get_link_map(n_workers)
                     todo_nodes = list(range(n_workers))
+                    broker = _BrokerPool(
+                        self._events, wait_conn, tree_map, parent_map,
+                        ring_map,
+                    )
                 else:
                     check_proto(
                         entry.world_size in (-1, n_workers),
@@ -303,69 +530,46 @@ class RabitTracker:
                         f"rank {rank} belongs to jobid {owner!r}, "
                         f"not {entry.jobid!r}",
                     )
+                    # an IN-FLIGHT session owns its rank just as a
+                    # completed one does — without this, a second client
+                    # claiming the rank mid-brokering would queue behind
+                    # the honest session and re-broker the same rank
+                    # (the serial tracker got this for free: sessions
+                    # completed before the next connection was read)
+                    check_proto(
+                        rank not in inflight,
+                        f"rank {rank} assignment already in flight "
+                        f"(jobid {inflight.get(rank)!r})",
+                    )
                 if rank == -1:
                     check_proto(bool(todo_nodes), "no free rank left")
                     pending.append(entry)
                 else:
-                    entry.assign_rank(
-                        rank, wait_conn, tree_map, parent_map, ring_map
-                    )
-                    # a rank reclaimed after dying mid-assignment is no
-                    # longer free. (If the dead worker had already wired
-                    # TCP links to peers, those peers hold dead sockets
-                    # until they notice and re-rendezvous via the recover
-                    # path — same contract as any post-assignment death.)
-                    if rank in todo_nodes:
-                        todo_nodes.remove(rank)
-                    # record the memo for direct-assigned workers too, so
-                    # the jobid→rank hijack checks protect them and their
-                    # own recover path finds the rank again
-                    if entry.jobid != "NULL":
-                        job_map[entry.jobid] = rank
+                    # direct assignment (recover / explicit rank / jobid
+                    # memo): reserve the rank and broker asynchronously.
+                    # A worker dying mid-brokering returns its rank via
+                    # the assign_failed event; the memo is recorded on
+                    # the assigned event, as the serial tracker did
+                    # post-assignment.
                     logger.debug("%s signal from %d", entry.cmd, entry.rank)
-                    if entry.wait_accept > 0:
-                        wait_conn[entry.rank] = entry
+                    submit(entry, rank)
                 # batch assignment fires when every free rank has a waiting
                 # worker — re-checked after BOTH branches because the else
                 # branch can shrink todo_nodes (reference accept_slaves,
                 # tracker.py:293-311). Sorted by host for locality.
-                # Failure-atomic: each entry is assigned under its own
-                # guard — a worker dying mid-brokering returns its rank to
+                # Failure-atomic: each session runs under its own guard —
+                # a worker dying mid-brokering returns its rank to
                 # todo_nodes and must reconnect; the rest of the batch
-                # still gets wired.
+                # still gets wired. Sessions whose neighborhoods are
+                # disjoint broker in parallel (_BrokerPool).
                 if pending and len(pending) == len(todo_nodes):
                     pending.sort(key=lambda e: e.host)
                     batch, pending = pending, []
                     for peer in batch:
-                        new_rank = todo_nodes.pop(0)
-                        try:
-                            peer.assign_rank(
-                                new_rank, wait_conn, tree_map,
-                                parent_map, ring_map,
-                            )
-                        except (ProtocolError, ConnectionError,
-                                OSError) as e:
-                            logger.warning(
-                                "assigning rank %d to %s failed: %s — "
-                                "rank returned to pool",
-                                new_rank, peer.host, e,
-                            )
-                            peer.sock.close()
-                            todo_nodes.insert(0, new_rank)
-                            continue
-                        if peer.jobid != "NULL":
-                            job_map[peer.jobid] = new_rank
-                        if peer.wait_accept > 0:
-                            wait_conn[new_rank] = peer
-                        logger.debug(
-                            "%s from %s; assigned rank %d",
-                            peer.cmd, peer.host, peer.rank,
-                        )
-                if not todo_nodes and self.start_time is None:
-                    logger.info(
-                        "@tracker all of %d nodes are started", n_workers
-                    )
-                    self.start_time = time.time()
+                        submit(peer, todo_nodes[0])
+                # start_time is set on the 'assigned' event once every
+                # rank's session COMPLETED — submission alone proves
+                # nothing (a session can still fail and return its rank)
             except ProtocolError as e:
                 logger.warning(
                     "protocol error from %s: %s — dropping connection",
@@ -386,6 +590,10 @@ class RabitTracker:
             )
 
     def start(self, n_workers: Optional[int] = None) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rabit-accept",
+        )
+        self._accept_thread.start()
         self.thread = threading.Thread(
             target=self._accept_workers,
             args=(n_workers or self.n_workers,),
@@ -406,6 +614,9 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        # the state thread blocks on its event queue, not on accept():
+        # closing the socket alone no longer terminates it
+        self._events.put(("stop", None, None, None))
 
 
 class PSTracker:

@@ -290,13 +290,24 @@ def test_pending_worker_unblocked_by_recover():
     tracker = RabitTracker("127.0.0.1", 2)
     tracker.start(2)
 
-    # two hostile clients: handshake, receive rank, vanish → both ranks leak
+    # two hostile clients: handshake, receive rank, vanish → both ranks
+    # leak. Read the ranks CONCURRENTLY: with parallel handshakes the
+    # tracker may assign either client first, and neighbor sessions are
+    # serialized — a sequential read of f1-then-f2 deadlocks against an
+    # f2-first assignment order.
     f1 = _handshake(tracker.port, world=2, jobid="h1")
     f2 = _handshake(tracker.port, jobid="h2")
-    f1.recv_int()
-    f1.close()
-    f2.recv_int()
-    f2.close()
+
+    def leak(fs):
+        fs.recv_int()
+        fs.close()
+
+    leakers = [threading.Thread(target=leak, args=(f,)) for f in (f1, f2)]
+    for t in leakers:
+        t.start()
+    for t in leakers:
+        t.join(timeout=15)
+    assert not any(t.is_alive() for t in leakers)
     time.sleep(0.3)
 
     fresh = RabitWorker("127.0.0.1", tracker.port, jobid="fresh")
@@ -328,6 +339,129 @@ def test_tracker_drops_slow_loris_client():
     tracker.close()
     stall.close()
     assert sorted(r[0] for r in results) == [0, 1]
+
+
+def test_stalling_client_does_not_serialize_rendezvous():
+    """r3 weak #5: one slow-but-alive client inside brokering stalled
+    every other worker (serial accept loop). Now sessions run
+    concurrently, serialized only between direct topology neighbors: a
+    staller holding rank 0 of a 12-node job must delay ONLY its
+    neighborhood — workers whose full neighbor set is far from rank 0
+    (ranks 7, 8, 9 under the n=12 tree+ring) complete rendezvous,
+    links wired, while the staller is still mid-stall."""
+    n = 12
+    tracker = RabitTracker("127.0.0.1", n, client_timeout=8.0)
+    tracker.start(n)
+
+    # staller: claims rank 0 (cmd=start, explicit rank), reads its
+    # topology frames, then goes silent inside the brokering loop
+    stall = _handshake(tracker.port, rank=0, world=n, jobid="stall")
+    assert stall.recv_int() == 0  # rank echo
+    stall.recv_int()  # parent
+    stall.recv_int()  # world
+    n_tree = stall.recv_int()
+    for _ in range(n_tree):
+        stall.recv_int()
+    stall.recv_int()  # ring prev
+    stall.recv_int()  # ring next
+    # ... and now it stalls: no ngood report, session thread blocked
+
+    t0 = time.time()
+    done_at = {}
+    workers = []
+
+    def one(i):
+        w = RabitWorker("127.0.0.1", tracker.port, jobid=f"w{i}")
+        rank = w.start(world_size=-1)
+        done_at[rank] = time.time() - t0
+        workers.append(w)
+
+    threads = [
+        threading.Thread(target=one, args=(i,)) for i in range(n - 1)
+    ]
+    for t in threads:
+        t.start()
+
+    # the far-from-staller workers must finish while the staller is
+    # still alive inside its session (client_timeout 8s; give them 6s).
+    # Which exact ranks wire first depends on session order; the
+    # invariant is that a NONTRIVIAL set completes instead of zero (the
+    # r3 serial tracker wedged the whole pod here), and none of them is
+    # a direct topology neighbor of the staller ({1, 2, 11}).
+    deadline = time.time() + 6.0
+    while time.time() < deadline and len(done_at) < 3:
+        time.sleep(0.05)
+    early = dict(done_at)
+    assert len(early) >= 3, (
+        f"only {len(early)} workers finished behind the staller: {early}"
+    )
+    assert all(t < 6.0 for t in early.values()), early
+    assert not {1, 2, 11} & set(early), early
+
+    # the staller times out (client_timeout) and its rank returns to the
+    # pool; a replacement worker (the supervisor-relaunch story) claims
+    # it, after which the whole job completes
+    def replacement():
+        # retried: until the staller's session times out, rank 0 is
+        # still reserved and the tracker rejects extra workers with
+        # "no free rank left" (same as the serial tracker)
+        for _ in range(40):
+            w = RabitWorker("127.0.0.1", tracker.port, jobid="relaunch")
+            try:
+                rank = w.start(world_size=-1)
+            except (ConnectionError, OSError):
+                time.sleep(0.5)
+                continue
+            done_at[rank] = time.time() - t0
+            workers.append(w)
+            return
+
+    rt = threading.Thread(target=replacement)
+    rt.start()
+    for t in threads:
+        t.join(timeout=30)
+    rt.join(timeout=30)
+    assert not rt.is_alive() and not any(t.is_alive() for t in threads)
+    assert sorted(done_at) == list(range(n))
+    for w in workers:
+        w.shutdown()
+    stall.close()
+    tracker.join()  # all n shutdowns seen: the state thread exits
+    tracker.close()
+
+
+def test_close_terminates_state_thread():
+    """tracker.close() must stop the state thread even with the job
+    incomplete (submit()'s abort path relies on it; the state thread
+    waits on its event queue, not accept())."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+    assert tracker.alive()
+    tracker.close()
+    deadline = time.time() + 5
+    while tracker.alive() and time.time() < deadline:
+        time.sleep(0.05)
+    assert not tracker.alive()
+
+
+def test_inflight_rank_cannot_be_claimed():
+    """A rank whose assignment session is still running is owned: a
+    second client claiming it mid-brokering must be rejected, exactly as
+    if the first had already completed (serial-tracker semantics)."""
+    tracker = RabitTracker("127.0.0.1", 2, client_timeout=5.0)
+    tracker.start(2)
+    # honest client claims rank 0 and parks mid-brokering
+    honest = _handshake(tracker.port, rank=0, world=2, jobid="jA")
+    assert honest.recv_int() == 0
+    time.sleep(0.3)
+    # hijacker claims the in-flight rank: must be dropped (its connection
+    # closes without a rank echo)
+    hijack = _handshake(tracker.port, rank=0, jobid="jB")
+    with pytest.raises((ConnectionError, OSError)):
+        hijack.recv_int()
+    hijack.close()
+    honest.close()
+    tracker.close()
 
 
 def test_tracker_rejects_rank_hijack():
