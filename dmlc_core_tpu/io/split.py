@@ -474,6 +474,19 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     Index file: whitespace-separated ``index offset`` pairs
     (ReadIndexFile, indexed_recordio_split.cc:43-62).
+
+    ``shuffle`` modes:
+
+    - ``True`` / ``'record'``: full per-record permutation — one seek
+      per record, exactly the reference's NextBatchEx shuffle
+      (indexed_recordio_split.cc:159-191). Statistically perfect,
+      seek-bound on every real filesystem.
+    - ``'batch'``: permute SPANS of ``batch_size`` contiguous records
+      and read each span with one coalesced seek (records inside a span
+      keep file order). The chunk-shuffle trade every production reader
+      makes (the reference's own ImageRecordIter-style consumers
+      re-shuffle in a client-side buffer); sequential-read throughput at
+      shuffle granularity ``batch_size``.
     """
 
     KRAND_MAGIC = 111  # reference indexed_recordio_split.h:82
@@ -485,11 +498,17 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         part_index: int = 0,
         num_parts: int = 1,
         batch_size: int = 256,
-        shuffle: bool = False,
+        shuffle=False,
         seed: int = 0,
         filesys: Optional[FileSystem] = None,
     ) -> None:
-        self.shuffle = shuffle
+        if shuffle in (False, None, 0):
+            self.shuffle_mode: Optional[str] = None
+        elif shuffle in ("batch", 2):
+            self.shuffle_mode = "batch"
+        else:
+            self.shuffle_mode = "record"
+        self.shuffle = self.shuffle_mode is not None
         self.batch_size = batch_size
         self._rnd = random.Random(self.KRAND_MAGIC + seed)
         self._index: List[Tuple[int, int]] = []  # (offset, size)
@@ -545,7 +564,15 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         (reference indexed_recordio_split.cc:221-233)."""
         if self.index_end <= self.index_begin:
             return
-        if self.shuffle:
+        if self.shuffle_mode == "batch":
+            # permute span STARTS; each span is batch_size contiguous
+            # records read in one seek
+            self._permutation = list(
+                range(self.index_begin, self.index_end, self.batch_size)
+            )
+            self._rnd.shuffle(self._permutation)
+            self._current = 0
+        elif self.shuffle_mode == "record":
             self._permutation = list(range(self.index_begin, self.index_end))
             self._rnd.shuffle(self._permutation)
             self._current = 0
@@ -582,7 +609,22 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def next_batch_ex(self, n_records: int) -> Optional[bytes]:
         """Reference NextBatchEx (indexed_recordio_split.cc:159-212):
-        shuffled = per-record seeks; sequential = one coalesced span."""
+        record-shuffled = per-record seeks; batch-shuffled = one
+        coalesced seek per permuted span; sequential = one span."""
+        if self.shuffle_mode == "batch":
+            if self._current >= len(self._permutation):
+                return None
+            s = self._permutation[self._current]
+            self._current += 1
+            e = min(s + self.batch_size, self.index_end)
+            begin_off = self._index[s][0]
+            end_off = (
+                self._index[e][0]
+                if e < len(self._index)
+                else self.file_offset[-1]
+            )
+            chunk = self._read_at(begin_off, end_off - begin_off)
+            return chunk if chunk else None
         if self.shuffle:
             n = self._n_overflow or n_records
             parts: List[bytes] = []
@@ -924,7 +966,7 @@ def create(
     num_parts: int = 1,
     type: str = "text",
     index_uri: Optional[str] = None,
-    shuffle: Optional[bool] = None,
+    shuffle=None,  # None | bool | 'record' | 'batch'
     seed: int = 0,
     batch_size: Optional[int] = None,
     recurse_directories: bool = False,
@@ -956,9 +998,21 @@ def create(
         type = "indexed_recordio"
     if seed == 0:
         seed = uri_int(spec.args, "seed", 0)
+    def norm_shuffle(v):
+        """None/0/False → off; 'batch'/2 → coalesced span shuffle;
+        'record'/1/True → per-record shuffle (reference semantics)."""
+        if v in (None, False, 0, "0", ""):
+            return False
+        if v in ("batch", 2, "2"):
+            return "batch"
+        if v in ("record", "1", 1, True):
+            return "record"
+        raise Error(f"invalid shuffle={v!r}: use 0/1/record/batch")
+
     if type == "indexed_recordio":
         if shuffle is None:
-            shuffle = bool(uri_int(spec.args, "shuffle", 0))
+            shuffle = spec.args.get("shuffle", "0")
+        shuffle = norm_shuffle(shuffle)
         if batch_size is None:
             batch_size = uri_int(spec.args, "batch_size", 256)
         check(
@@ -966,7 +1020,8 @@ def create(
             "indexed shuffle with a #cachefile would freeze the first "
             "epoch's shuffle order into the cache; pick one",
         )
-    shuffle = bool(shuffle)
+    else:
+        shuffle = norm_shuffle(shuffle)
     batch_size = 256 if batch_size is None else batch_size
     if type == "text" and spec.uri == "-":
         return SingleFileSplit("-")

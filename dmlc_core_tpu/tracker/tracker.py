@@ -513,6 +513,16 @@ class RabitTracker:
                 check_proto(
                     rank < n_workers, f"rank {rank} out of range"
                 )
+                # one assignment per jobid at a time: the memo is only
+                # recorded on session completion, so without this a
+                # jobid could broker two ranks concurrently (the serial
+                # tracker's synchronous memo made this impossible)
+                check_proto(
+                    entry.jobid == "NULL"
+                    or entry.jobid not in inflight.values(),
+                    f"jobid {entry.jobid!r} already has an assignment "
+                    "in flight",
+                )
                 if rank != -1:
                     # consistency with the jobid→rank memo: a client naming
                     # an in-range rank must not contradict (or hijack) a

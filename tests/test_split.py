@@ -203,6 +203,51 @@ def test_indexed_recordio_shuffle_permutes_and_covers():
         assert all_records(s2) == epoch1
 
 
+def test_indexed_recordio_batch_shuffle_coalesced():
+    """shuffle='batch': spans of batch_size contiguous records permuted,
+    one coalesced read per span — full coverage, span-internal order
+    preserved, reshuffled per epoch, sharding exact."""
+    records = [f"brec{i:02d}".encode() for i in range(37)]
+    with TemporaryDirectory() as tmp:
+        p, idx = make_indexed_rec(tmp.path, records)
+        s = IndexedRecordIOSplitter(
+            p, idx, 0, 1, batch_size=5, shuffle="batch", seed=9
+        )
+        epoch1 = all_records(s)
+        s.before_first()
+        epoch2 = all_records(s)
+        assert sorted(epoch1) == sorted(records)  # full coverage
+        assert sorted(epoch2) == sorted(records)
+        assert epoch1 != records  # span order permuted
+        assert epoch1 != epoch2  # reshuffled per epoch
+        # span-internal order preserved: every aligned 5-record span of
+        # the original appears contiguously
+        spans = [records[i:i + 5] for i in range(0, len(records), 5)]
+        for span in spans:
+            i = epoch1.index(span[0])
+            assert epoch1[i:i + len(span)] == span
+        # sharding stays exact under batch shuffle
+        got = []
+        for rank in range(3):
+            got.extend(
+                all_records(
+                    IndexedRecordIOSplitter(
+                        p, idx, rank, 3, batch_size=5, shuffle="batch"
+                    )
+                )
+            )
+        assert sorted(got) == sorted(records)
+        # URI sugar routes the mode
+        from dmlc_core_tpu.io import split as io_split
+
+        sp = io_split.create(
+            f"{p}?index={idx}&shuffle=batch&batch_size=5",
+            type="recordio", threaded=False,
+        )
+        assert isinstance(sp, IndexedRecordIOSplitter)
+        assert sp.shuffle_mode == "batch"
+
+
 # -- wrappers ----------------------------------------------------------------
 def test_threaded_input_split_prefetch():
     lines = [f"t{i}".encode() for i in range(100)]

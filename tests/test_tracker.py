@@ -464,6 +464,23 @@ def test_inflight_rank_cannot_be_claimed():
     tracker.close()
 
 
+def test_inflight_jobid_cannot_claim_second_rank():
+    """The jobid→rank memo is recorded on session completion; a jobid
+    with an assignment still in flight must not be able to broker a
+    SECOND rank concurrently (serial-tracker memo semantics)."""
+    tracker = RabitTracker("127.0.0.1", 4, client_timeout=5.0)
+    tracker.start(4)
+    honest = _handshake(tracker.port, rank=0, world=4, jobid="jA")
+    assert honest.recv_int() == 0  # mid-brokering, memo not yet recorded
+    time.sleep(0.3)
+    dup = _handshake(tracker.port, rank=3, jobid="jA")
+    with pytest.raises((ConnectionError, OSError)):
+        dup.recv_int()
+    dup.close()
+    honest.close()
+    tracker.close()
+
+
 def test_tracker_rejects_rank_hijack():
     """A hostile client claiming a live worker's rank (with a different
     jobid) is rejected by the jobid→rank consistency check; the real job
