@@ -97,6 +97,7 @@ class _EllResult(ctypes.Structure):
         ("bytes_consumed", ctypes.c_int64),
         ("truncated", ctypes.c_int64),
         ("bad_records", ctypes.c_int64),
+        ("corrupt", ctypes.c_int64),
     ]
 
 
@@ -413,8 +414,10 @@ def parse_rowrec_ell(
     Stops at buffer-full or at a trailing partial record (the caller's next
     window must resume at ``offset + bytes_consumed``). Rows with more than
     K features keep the first K (dropped count in ``truncated``). Returns
-    (rows_written, bytes_consumed, truncated, bad_records), or None if the
-    kernel is missing.
+    (rows_written, bytes_consumed, truncated, bad_records, corrupt) —
+    ``corrupt`` set when a full frame header is present but carries no
+    magic (broken stream, fail fast; a trailing partial is NOT corrupt) —
+    or None if the kernel is missing.
     """
     if not HAS_ELL:
         return None
@@ -435,7 +438,8 @@ def parse_rowrec_ell(
         ctypes.c_int64(capacity),
         ctypes.byref(res),
     )
-    return res.rows_written, res.bytes_consumed, res.truncated, res.bad_records
+    return (res.rows_written, res.bytes_consumed, res.truncated,
+            res.bad_records, res.corrupt)
 
 
 def _check_ell_buffers(indices, values, nnz, labels, weights):

@@ -144,6 +144,18 @@ class IndexedRecordIOWriter(RecordIOWriter):
 
     def __init__(self, stream: Stream, index_stream: Stream) -> None:
         super().__init__(stream)
+        # enforce the byte-0 contract instead of documenting it: an
+        # append-positioned seekable stream would silently emit a corrupt
+        # index (ADVICE r3). Non-seekable sinks (pipes) stay permitted.
+        try:
+            pos = stream.tell()
+        except (OSError, AttributeError, Error):
+            pos = 0
+        check(
+            pos == 0,
+            f"IndexedRecordIOWriter must start at byte 0 of the "
+            f"destination (stream is at {pos}); offsets would be wrong",
+        )
         self.index_stream = index_stream
         self._count = 0
 

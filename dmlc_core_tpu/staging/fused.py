@@ -155,12 +155,34 @@ def _read_uri_head(uri: str, nbytes: int = 262144) -> bytes:
         stream.close()
 
 
+def _probe_cache_key(uri: str):
+    """(uri, mtime, size) for plain LOCAL files, so a file rewritten at
+    the same path (tests, regenerated datasets) never resolves a stale
+    cached indexing base (ADVICE r3). Remote and wildcard URIs keep the
+    uri-only per-process key — a stat per producer construction there
+    would cost a network round trip per sub-shard, the exact cost the
+    cache exists to avoid."""
+    base = uri.split(";")[0].split("?")[0]
+    if "://" not in base:
+        try:
+            st = os.stat(base)
+            return (uri, st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+    return (uri, 0, -1)
+
+
 @lru_cache(maxsize=64)
+def _probe_base_cached(key) -> int:
+    return _probe_base(_read_uri_head(key[0]))
+
+
 def _probe_base_from_uri(uri: str) -> int:
-    """Resolve libsvm auto indexing from the file head. Cached per URI: a
-    threaded fan-out constructs one producer per sub-shard and must not
-    re-read (possibly remote) file heads per thread."""
-    return _probe_base(_read_uri_head(uri))
+    """Resolve libsvm auto indexing from the file head. Cached per
+    (uri, mtime, size): a threaded fan-out constructs one producer per
+    sub-shard and must not re-read (possibly remote) file heads per
+    thread — but a rewritten file must re-probe."""
+    return _probe_base_cached(_probe_cache_key(uri))
 
 
 def _probe_base(chunk) -> int:
@@ -516,6 +538,13 @@ class FusedEllRowRecBatches(_EllSlotMixin):
               "fused ELL path stages int32 indices")
         self.spec = spec
         uspec = URISpec(uri, part_index, num_parts)
+        # only path+query are forwarded below — a #cachefile would be
+        # SILENTLY ignored; fail loudly like the shuffle+cachefile guards
+        check(
+            not uspec.cache_file,
+            "fused rowrec staging does not take a #cachefile (it already "
+            "reads the binary shard at full speed); drop the fragment",
+        )
         # epoch shuffling (?shuffle_parts=N&seed=S) and count-indexed
         # access (?index=...&shuffle=1) ride the URI; both reorder reads,
         # so the sequential mmap fast path is only taken without them
@@ -555,12 +584,20 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         """Parse chunk[off:] into the current slot; returns updated
         (off, fill, made_progress)."""
         indices, values, nnz, labels, weights, _packed = self._ring[self._slot]
-        rows, consumed, trunc, bad = native.parse_rowrec_ell(
+        rows, consumed, trunc, bad, corrupt = native.parse_rowrec_ell(
             chunk, off, indices, values, nnz, labels, weights, fill
         )
         self.rows_in += rows
         self.truncated_nnz += trunc
         self.bad_records += bad
+        if corrupt:
+            # bad magic with a full header in view: the stream is broken
+            # HERE — fail fast instead of carrying the rest of the shard
+            # as a 'partial record' until end-of-split (ADVICE r3)
+            raise Error(
+                "rowrec: corrupt RecordIO frame (bad magic) at byte "
+                f"{off + consumed} of the current chunk"
+            )
         return off + consumed, fill + rows, (rows > 0 or consumed > 0)
 
     def __iter__(self) -> Iterator[Batch]:
@@ -586,14 +623,14 @@ class FusedEllRowRecBatches(_EllSlotMixin):
                     fill = 0
                 elif not progressed:
                     # trailing partial record (a chain straddling the
-                    # chunk boundary) — or a corrupt frame, which never
-                    # completes and is diagnosed at end of split: carry
-                    # the tail into the next chunk
+                    # chunk boundary): carry the tail into the next
+                    # chunk. (A corrupt frame raised inside _feed — it
+                    # can never reach here.)
                     carry = bytes(memoryview(chunk)[off:])
                     break
         if carry:
             raise Error(
-                "rowrec: truncated or corrupt RecordIO stream "
+                "rowrec: truncated RecordIO stream "
                 f"({len(carry)} undecodable trailing bytes)"
             )
         if fill:
@@ -620,11 +657,12 @@ class FusedEllRowRecBatches(_EllSlotMixin):
             self._split.advance(off)
             if stalled and off == 0:
                 # not one complete record fit the window: widen it (a
-                # window that already reaches EOF means a truncated file)
+                # window that already reaches EOF means a truncated
+                # file; corrupt frames raise inside _feed)
                 if not self._split.grow():
                     raise Error(
-                        "rowrec: record larger than remaining file or "
-                        "corrupt RecordIO frame"
+                        "rowrec: truncated RecordIO stream (record "
+                        "extends past end of file)"
                     )
         if fill:
             yield from self._tail(fill)
@@ -778,10 +816,15 @@ class ShardedFusedBatches:
 
 
 @lru_cache(maxsize=64)
+def _probe_libfm_base_cached(key) -> int:
+    return _probe_libfm_base(_read_uri_head(key[0]))
+
+
 def _probe_libfm_base_from_uri(uri: str) -> int:
     """Resolve libfm auto indexing from the file head (same caching and
-    shard-consistency rationale as ``_probe_base_from_uri``)."""
-    return _probe_libfm_base(_read_uri_head(uri))
+    shard-consistency rationale as ``_probe_base_from_uri``, same
+    (uri, mtime, size) staleness key)."""
+    return _probe_libfm_base_cached(_probe_cache_key(uri))
 
 
 def _probe_libfm_base(chunk) -> int:

@@ -1052,6 +1052,10 @@ struct EllResult {
   int64_t bytes_consumed;
   int64_t truncated;
   int64_t bad_records;  // malformed payloads skipped
+  int64_t corrupt;      // bad magic with a full header available: the
+                        // stream is broken HERE, not merely truncated —
+                        // callers fail fast instead of carrying the rest
+                        // of the shard hoping a later window completes it
 };
 
 DMLC_API void dmlc_parse_rowrec_ell(
@@ -1062,6 +1066,7 @@ DMLC_API void dmlc_parse_rowrec_ell(
   EllState st{indices, values, nnz, labels, weights, max_nnz, out_f16 != 0, 0};
   int64_t row = row_start;
   int64_t bad = 0;
+  bool corrupt = false;
   const char* p = buf;
   const char* end = buf + len;
   std::vector<char> chain;  // reassembly buffer for multi-part records
@@ -1078,8 +1083,10 @@ DMLC_API void dmlc_parse_rowrec_ell(
       if (end - p < 8) break;  // partial header: stop at rec_start
       const uint32_t magic = load_u32(p);
       if (magic != kRecMagic) {
-        // corrupt frame — unrecoverable inside this window; report what we
-        // have (the Python side raises on bytes_consumed going nowhere)
+        // full header available but no magic: corrupt, not partial —
+        // flag it so the caller fails fast instead of accumulating the
+        // rest of the shard as carry (ADVICE r3)
+        corrupt = true;
         break;
       }
       const uint32_t lrec = load_u32(p + 4);
@@ -1128,6 +1135,7 @@ DMLC_API void dmlc_parse_rowrec_ell(
   out->bytes_consumed = consumed_to - buf;
   out->truncated = st.truncated;
   out->bad_records = bad;
+  out->corrupt = corrupt ? 1 : 0;
 }
 
 // -- fused libfm -> fixed-shape ELL batch -------------------------------------

@@ -372,6 +372,202 @@ def test_sharded_fused_libsvm_exact_cover(tmp_path):
     assert sharded_stream.rows_out == n
 
 
+def _ell_rows(stream):
+    """Full per-row ELL payload copied out of the ring (order-free)."""
+    rows = []
+    for b in stream:
+        for i in range(b.n_valid):
+            rows.append((
+                float(b.labels[i]), float(b.weights[i]), int(b.nnz[i]),
+                tuple(np.asarray(b.indices[i])),
+                tuple(np.asarray(b.values[i]).astype(np.float32)),
+            ))
+    return rows
+
+
+@pytest.mark.parametrize("nthread", [2, 4])
+@pytest.mark.parametrize(
+    "fmt", ["libsvm_dense", "csv_dense", "rowrec", "libsvm_ell", "libfm_ell"]
+)
+def test_nthread_equivalence_all_paths(tmp_path, fmt, nthread):
+    """VERDICT r3 #8 gate: every fused path's global output is IDENTICAL
+    (full row payloads + truncation counters, as a multiset) for
+    nthread ∈ {1, 2, 4}. The bench host has 1 vCPU, so the fan-out's
+    perf is unverifiable there — this pins that engaging it can never
+    change results, only speed."""
+    from dmlc_core_tpu.staging import dense_batches, ell_batches
+
+    rng = np.random.default_rng(100)
+    n = 1500
+    if fmt == "libsvm_dense":
+        d = 7
+        p = tmp_path / "a.libsvm"
+        p.write_text("".join(
+            f"{i % 2} " + " ".join(
+                f"{j}:{rng.normal():.5f}" for j in range(d)
+            ) + "\n"
+            for i in range(n)
+        ))
+        make = lambda nt: dense_batches(
+            str(p),
+            BatchSpec(batch_size=128, layout="dense", num_features=d),
+            nthread=nt,
+        )
+        collect = _collect_rows
+    elif fmt == "csv_dense":
+        d = 5
+        p = tmp_path / "a.csv"
+        p.write_text("".join(
+            f"{i % 2}," + ",".join(
+                f"{rng.normal():.5f}" for _ in range(d)
+            ) + "\n"
+            for i in range(n)
+        ))
+        make = lambda nt: dense_batches(
+            str(p) + "?format=csv&label_column=0",
+            BatchSpec(batch_size=128, layout="dense", num_features=d),
+            nthread=nt,
+        )
+        collect = _collect_rows
+    else:
+        k = 5
+        if fmt == "rowrec":
+            from dmlc_core_tpu.data.row_block import RowBlock
+            from dmlc_core_tpu.data.rowrec import write_rowrec
+            from dmlc_core_tpu.io.stream import FileStream
+
+            blk = RowBlock(
+                offset=np.arange(n + 1, dtype=np.int64) * k,
+                label=np.arange(n, dtype=np.float32),
+                index=rng.integers(0, 999, n * k).astype(np.uint32),
+                value=rng.normal(size=n * k).astype(np.float32),
+            )
+            p = tmp_path / "a.rec"
+            with FileStream(str(p), "w") as f:
+                write_rowrec(f, [blk])
+            uri = str(p)
+        elif fmt == "libsvm_ell":
+            p = tmp_path / "a.svm"
+            p.write_text("".join(
+                f"{i % 2} " + " ".join(
+                    f"{int(rng.integers(0, 5000))}:{rng.normal():.4f}"
+                    for _ in range(int(rng.integers(1, 8)))
+                ) + "\n"
+                for i in range(n)
+            ))
+            uri = str(p) + "?format=libsvm"
+        else:
+            p = tmp_path / "a.libfm"
+            p.write_text("".join(
+                f"{i % 2} " + " ".join(
+                    f"{int(rng.integers(0, 9))}:"
+                    f"{int(rng.integers(0, 5000))}:{rng.normal():.4f}"
+                    for _ in range(int(rng.integers(1, 8)))
+                ) + "\n"
+                for i in range(n)
+            ))
+            uri = str(p) + "?format=libfm"
+        make = lambda nt: ell_batches(
+            uri, BatchSpec(batch_size=128, layout="ell", max_nnz=k),
+            nthread=nt,
+        )
+        collect = _ell_rows
+
+    base_stream = make(None)
+    base = collect(base_stream)
+    base_trunc = base_stream.truncated_nnz
+    base_stream.close()
+    s = make(nthread)
+    got = collect(s)
+    trunc = s.truncated_nnz
+    s.close()
+    assert sorted(got) == sorted(base), (fmt, nthread)
+    assert trunc == base_trunc, (fmt, nthread)
+
+
+def test_rowrec_corrupt_frame_fails_fast(tmp_path):
+    """A bad-magic frame mid-shard must raise immediately (corrupt), not
+    accumulate the remaining shard as a 'partial record' until
+    end-of-split (ADVICE r3). A trailing truncation stays a truncation
+    error."""
+    from dmlc_core_tpu.data.row_block import RowBlock
+    from dmlc_core_tpu.data.rowrec import write_rowrec
+    from dmlc_core_tpu.io.stream import FileStream
+    from dmlc_core_tpu.staging import ell_batches
+    from dmlc_core_tpu.utils.logging import Error as DmlcError
+
+    rng = np.random.default_rng(5)
+    n, k = 200, 3
+    blk = RowBlock(
+        offset=np.arange(n + 1, dtype=np.int64) * k,
+        label=np.arange(n, dtype=np.float32),
+        index=rng.integers(0, 99, n * k).astype(np.uint32),
+        value=rng.normal(size=n * k).astype(np.float32),
+    )
+    rec = str(tmp_path / "c.rec")
+    with FileStream(rec, "w") as f:
+        write_rowrec(f, [blk])
+    data = open(rec, "rb").read()
+    frame = 8 + 12 + k * 8
+    # clobber the magic of a mid-file frame
+    bad = bytearray(data)
+    bad[frame * 50: frame * 50 + 4] = b"\xde\xad\xbe\xef"
+    corrupt_path = tmp_path / "corrupt.rec"
+    corrupt_path.write_bytes(bytes(bad))
+    # force the non-mmap path (the carry-accumulation path ADVICE flagged)
+    spec = BatchSpec(batch_size=64, layout="ell", max_nnz=k)
+    s = ell_batches(str(corrupt_path) + "?shuffle_parts=1", spec)
+    # 'bad magic' only: the OLD end-of-split message ('truncated or
+    # corrupt ... trailing bytes') must NOT satisfy this test — the point
+    # is the immediate raise, not the late diagnosis
+    with pytest.raises(DmlcError, match="bad magic"):
+        for _ in s:
+            pass
+    s.close()
+
+
+def test_fused_rowrec_rejects_cachefile(tmp_path):
+    """#cachefile is silently dropped by the fused rowrec path's URI
+    forwarding — it must be refused loudly (ADVICE r3)."""
+    from dmlc_core_tpu.staging import FusedEllRowRecBatches
+    from dmlc_core_tpu.utils.logging import Error as DmlcError
+
+    with pytest.raises(DmlcError, match="cachefile"):
+        FusedEllRowRecBatches(
+            str(tmp_path / "x.rec") + "#" + str(tmp_path / "cache"),
+            BatchSpec(batch_size=8, layout="ell", max_nnz=2),
+        )
+
+
+def test_indexed_writer_requires_byte0(tmp_path):
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream, MemoryStream
+    from dmlc_core_tpu.utils.logging import Error as DmlcError
+
+    p = str(tmp_path / "a.rec")
+    with open(p, "wb") as f:
+        f.write(b"prefix")
+    data = FileStream(p, "a")
+    with pytest.raises(DmlcError, match="byte 0"):
+        IndexedRecordIOWriter(data, MemoryStream())
+    data.close()
+
+
+def test_probe_cache_invalidated_on_rewrite(tmp_path):
+    """Auto indexing-base probes are cached by (uri, mtime, size): a file
+    rewritten at the same path must re-probe (ADVICE r3)."""
+    import time as time_mod
+
+    from dmlc_core_tpu.staging.fused import _probe_base_from_uri
+
+    p = tmp_path / "p.libsvm"
+    p.write_text("1 1:0.5 2:0.5\n")  # 1-based heuristic
+    assert _probe_base_from_uri(str(p)) == 1
+    time_mod.sleep(0.01)
+    p.write_text("1 0:0.5 2:0.75\n")  # id 0 appears → 0-based, new size
+    assert _probe_base_from_uri(str(p)) == 0
+
+
 @pytest.mark.jax
 def test_sharded_fused_rowrec_through_pipeline(tmp_path):
     """Threaded ELL fan-out through the staging pipeline: every label
