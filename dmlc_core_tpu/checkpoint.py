@@ -29,6 +29,7 @@ from __future__ import annotations
 import os
 import re
 import shutil
+import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -43,6 +44,7 @@ __all__ = [
     "load_pytree",
     "save_pytree_sharded",
     "load_pytree_sharded",
+    "AsyncSave",
     "Checkpointer",
 ]
 
@@ -130,16 +132,42 @@ def _tree_map2(fn, tree, other):
     return fn(tree, other)
 
 
-def _sync_processes(name: str) -> None:
-    """Barrier across jax processes (no-op single-process / jax absent)."""
+def _sync_processes(name: str, coordination_only: bool = False) -> None:
+    """Barrier across jax processes (no-op single-process / jax absent).
+
+    ``coordination_only``: use the distributed COORDINATION-SERVICE
+    barrier instead of a device collective. Mandatory from background
+    threads (async checkpointing): a device-collective barrier issued
+    concurrently with training collectives can interleave in different
+    orders on different processes and deadlock the pod. Falls back to
+    the device barrier only when no coordination client exists (then the
+    caller must not overlap device work)."""
     try:
         import jax
     except ImportError:
         return
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    if jax.process_count() <= 1:
+        return
+    if coordination_only:
+        client = getattr(
+            getattr(jax._src, "distributed", None), "global_state", None
+        )
+        client = getattr(client, "client", None)
+        if client is None:
+            # NEVER fall back to a device collective here — that is the
+            # exact cross-thread collective-ordering deadlock this flag
+            # exists to prevent. Fail loudly instead of hanging the pod.
+            raise Error(
+                "async multi-process checkpointing requires the jax "
+                "coordination service (jax.distributed.initialize) — "
+                "unavailable in this runtime; use the synchronous save()"
+            )
+        # barrier ids must be unique per use; callers embed a seq no
+        client.wait_at_barrier(name.replace("/", "_"), 600_000)
+        return
+    from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+    multihost_utils.sync_global_devices(name)
 
 
 def _norm_index(index, shape) -> Tuple[List[int], List[int]]:
@@ -189,6 +217,20 @@ def save_pytree_sharded(
         except ImportError:
             process_count = 1
 
+    skeleton, chunks = _snapshot_sharded(tree)
+    _write_sharded(
+        dir_uri, skeleton, chunks, process_index, process_count,
+        barrier_tag="", coordination_only=False,
+    )
+
+
+def _snapshot_sharded(tree: Any):
+    """Device→host snapshot: skeleton + this process's replica-0 chunks.
+
+    Runs in the CALLER's thread — after it returns, the checkpoint no
+    longer references device buffers, so training may donate/overwrite
+    params while a background thread does the file I/O (the async path).
+    """
     leaves: List[Any] = []
 
     def skel(x):
@@ -231,7 +273,24 @@ def save_pytree_sharded(
             mine.append((starts, stops, np.asarray(shard.data)))
         if mine:
             chunks[leaf_id] = mine
+    return skeleton, chunks
 
+
+def _write_sharded(
+    dir_uri: str,
+    skeleton: Any,
+    chunks,
+    process_index: int,
+    process_count: int,
+    barrier_tag: str = "",
+    coordination_only: bool = False,
+) -> None:
+    """The I/O + completeness protocol of a sharded save (collective).
+
+    ``barrier_tag`` disambiguates coordination-service barrier ids
+    across repeated saves (ids are single-use); ``coordination_only``
+    must be True when called from a background thread (see
+    _sync_processes)."""
     base = dir_uri.rstrip("/")
     if process_index == 0:
         _clear_manifest(base)
@@ -240,16 +299,18 @@ def save_pytree_sharded(
     # any process rewrites a shard file — otherwise a crash mid-rewrite
     # leaves a dir that still claims completeness over mixed old/new
     # shards. Torn (= manifest-less) is the only crash state allowed.
-    _sync_processes(f"dmlc_ckpt_clear:{base}")
+    _sync_processes(f"dmlc_ckpt_clear:{base}:{barrier_tag}", coordination_only)
     shard_uri = f"{base}/shard-{process_index:05d}.bin"
     _write_atomic(shard_uri, {"proc": process_index, "chunks": chunks})
-    _sync_processes(f"dmlc_ckpt_shards:{base}")
+    _sync_processes(f"dmlc_ckpt_shards:{base}:{barrier_tag}", coordination_only)
     if process_index == 0:
         _write_atomic(
             f"{base}/{_MANIFEST}",
             {"tree": skeleton, "nprocs": process_count},
         )
-    _sync_processes(f"dmlc_ckpt_manifest:{base}")
+    _sync_processes(
+        f"dmlc_ckpt_manifest:{base}:{barrier_tag}", coordination_only
+    )
 
 
 def _as_local(uri: str) -> Optional[str]:
@@ -419,6 +480,30 @@ def _place(host: np.ndarray, template) -> Any:
     )
 
 
+class AsyncSave:
+    """Handle for an in-flight background checkpoint write."""
+
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self.uri: Optional[str] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Optional[str]:
+        """Block until the write completes; returns the checkpoint URI.
+        Re-raises any write failure — an awaited checkpoint that silently
+        vanished would defeat the resume contract."""
+        check(
+            self._done.wait(timeout),
+            f"checkpoint write still in flight after {timeout}s",
+        )
+        if self._exc is not None:
+            raise self._exc
+        return self.uri
+
+
 class Checkpointer:
     """Step-numbered checkpoints under a base URI.
 
@@ -455,6 +540,9 @@ class Checkpointer:
         self._proc = process_index
         self._count = process_count
         self._sharded = sharded
+        self._inflight: Optional[AsyncSave] = None
+        self._seq = 0  # per-save barrier-id disambiguator (collective:
+        #               every process increments in the same order)
 
     # -- helpers -------------------------------------------------------------
     def _is_writer(self) -> bool:
@@ -536,10 +624,115 @@ class Checkpointer:
         except ImportError:
             return False
 
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Drain any in-flight async save (re-raising its failure).
+
+        On timeout the handle stays registered — a still-running write
+        must not be forgotten, or a subsequent save/restore would race
+        it (and in multi-process runs start mismatched barrier ids)."""
+        handle = self._inflight
+        if handle is None:
+            return
+        finished = handle._done.wait(timeout)
+        if not finished:
+            raise Error(
+                f"checkpoint write still in flight after {timeout}s"
+            )
+        self._inflight = None
+        if handle._exc is not None:
+            raise handle._exc
+
+    def save_async(self, step: int, tree: Any) -> AsyncSave:
+        """Checkpoint with the file I/O overlapped against training.
+
+        The device→host snapshot happens HERE, synchronously — after
+        this returns, the tree's device buffers are no longer referenced,
+        so the caller may donate/overwrite params in the next step. The
+        serialization, upload, completeness barriers, and retention run
+        on a background thread; in multi-process runs the barriers use
+        the jax coordination service (never device collectives, which
+        would deadlock against the training step's). Collective: every
+        process must call it in the same order. Saves are serialized —
+        a second save_async drains the first.
+        """
+        self.wait()
+        self._seq += 1
+        tag = f"{self._seq}"
+        handle = AsyncSave()
+        sharded = self._needs_sharded(tree)
+        if sharded:
+            # resolve rank/count EXACTLY like the sync path
+            # (save_pytree_sharded): each falls back to jax independently
+            # — 'index given, count from jax' is the tracker-launched
+            # case, and count=1 there would write an unrestorable
+            # manifest
+            proc, count = self._proc, self._count
+            try:
+                import jax
+
+                if proc is None:
+                    proc = jax.process_index()
+                if count is None:
+                    count = jax.process_count()
+            except ImportError:
+                proc = 0 if proc is None else proc
+                count = 1 if count is None else count
+            path = self._path(step, sharded=True)
+            skeleton, chunks = _snapshot_sharded(tree)  # caller thread
+
+            def work():
+                _write_sharded(
+                    path, skeleton, chunks, proc, count,
+                    barrier_tag=tag,
+                    coordination_only=count > 1,
+                )
+                if proc == 0:
+                    _remove_uri(self._path(step))
+                    self._prune()
+                    log_info(
+                        f"async sharded checkpoint step {step} -> {path}"
+                    )
+                return path
+        else:
+            host_tree = _to_host(tree)  # caller thread: donation-safe
+            path = self._path(step)
+            is_writer = self._is_writer()
+
+            def work():
+                if not is_writer:
+                    # same contract as sync save(): None on non-writers —
+                    # the URI is only meaningful where the file exists
+                    return None
+                sharded_path = self._path(step, sharded=True)
+                if self._manifest_ok(sharded_path):
+                    _clear_manifest(sharded_path)
+                    _write_atomic(path, host_tree)
+                    _remove_uri(sharded_path, tree_ok=True)
+                else:
+                    _write_atomic(path, host_tree)
+                self._prune()
+                log_info(f"async checkpoint step {step} -> {path}")
+                return path
+
+        def run():
+            try:
+                handle.uri = work()
+            except BaseException as e:  # surfaced via result()
+                handle._exc = e
+            finally:
+                handle._done.set()
+
+        threading.Thread(
+            target=run, daemon=True, name=f"ckpt-async-{step}"
+        ).start()
+        self._inflight = handle
+        return handle
+
     def save(self, step: int, tree: Any) -> Optional[str]:
         """Returns the checkpoint URI (None on non-writer processes in
         the legacy single-file layout; the sharded layout is collective —
         every process writes its shard and gets the URI back)."""
+        self.wait()  # an overlapping async write to the same base
         if self._needs_sharded(tree):
             path = self._path(step, sharded=True)
             save_pytree_sharded(
@@ -581,6 +774,7 @@ class Checkpointer:
         ``template``: optional pytree of jax arrays / ShapeDtypeStructs
         whose shardings say where each restored leaf should live on the
         CURRENT mesh (resharding restore). Applies to both layouts."""
+        self.wait()  # never read past an in-flight write
         if step is None:
             step = self.latest_step()
             check(step is not None, f"no checkpoints under {self.base}")

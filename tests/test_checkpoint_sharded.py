@@ -240,6 +240,54 @@ def test_legacy_restore_applies_template(tmp_path):
     np.testing.assert_array_equal(np.asarray(back["w"]), w)
 
 
+def test_async_save_overlaps_and_restores(tmp_path):
+    """save_async snapshots on the caller thread (donation-safe: device
+    buffers may be deleted right after it returns) and writes in the
+    background; restore/wait drain it."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.parallel import make_mesh
+
+    mesh = make_mesh((4, 2), ("data", "model"))
+    w = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, P(None, "model")),
+    )
+    ck = Checkpointer(str(tmp_path / "ck"), sharded=True)
+    handle = ck.save_async(4, {"w": w})
+    # simulate donation: the device buffers die right after save_async
+    w.delete()
+    uri = handle.result(timeout=30)
+    assert uri is not None and uri.endswith(".d") and handle.done()
+    step, back = ck.restore()
+    assert step == 4
+    np.testing.assert_array_equal(
+        back["w"], np.arange(64, dtype=np.float32).reshape(8, 8)
+    )
+    # consecutive async saves serialize and retention still applies
+    for s in (5, 6, 7):
+        ck.save_async(
+            s, {"w": jax.device_put(np.full((8, 8), s, np.float32),
+                                    NamedSharding(mesh, P(None, "model")))}
+        )
+    ck.wait()
+    assert ck.steps() == [5, 6, 7]  # keep=3 pruned step 4
+
+
+def test_async_save_failure_surfaces(tmp_path):
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.utils.logging import Error as DmlcError
+
+    target = tmp_path / "blocked"
+    target.write_text("a file where the checkpoint dir must go")
+    ck = Checkpointer(str(target / "sub"), process_index=0, sharded=False)
+    handle = ck.save_async(1, {"w": np.ones(3, np.float32)})
+    with pytest.raises((OSError, DmlcError)):
+        handle.result(timeout=30)
+
+
 N_STEPS = 6
 CKPT_STEP = 3
 
@@ -308,6 +356,18 @@ if mode == "straight":
         if i + 1 == {ckpt_step}:
             uri = ck.save(i + 1, params)
             assert uri is not None and uri.endswith(".d"), uri
+elif mode == "straight_async":
+    handle = None
+    for i in range({n_steps}):
+        params, loss = step(params, bs[i])
+        losses.append(float(loss))
+        if i + 1 == {ckpt_step}:
+            # async write overlaps the REMAINING training steps; its
+            # coordination-service barriers must not deadlock against
+            # the training step's device collectives
+            handle = ck.save_async(i + 1, params)
+    uri = handle.result(timeout=120)
+    assert uri is not None and uri.endswith(".d"), uri
 else:
     got_step, params = ck.restore(template=params)
     assert got_step == {ckpt_step}, got_step
@@ -369,14 +429,16 @@ def _run_pair(tmp_path, tag, mode, ckdir, out):
 
 
 @pytest.mark.slow
-def test_two_process_midrun_checkpoint_resume_bitexact(tmp_path):
+@pytest.mark.parametrize("save_mode", ["straight", "straight_async"])
+def test_two_process_midrun_checkpoint_resume_bitexact(tmp_path, save_mode):
     """Straight 6-step run (checkpointing at step 3) == restart from the
     step-3 checkpoint and run steps 4-6: loss trajectories bit-identical,
-    with v tp-sharded P(None,'model') across 2 processes the whole time."""
+    with v tp-sharded P(None,'model') across 2 processes the whole time.
+    The async variant keeps training DURING the background write."""
     ckdir = str(tmp_path / "ck")
     out_s = str(tmp_path / "straight")
     out_r = str(tmp_path / "resume")
-    _run_pair(tmp_path, "s", "straight", ckdir, out_s)
+    _run_pair(tmp_path, "s", save_mode, ckdir, out_s)
 
     # the sharded layout really is multi-file: one shard per process
     dirs = [d for d in os.listdir(ckdir) if d.endswith(".d")]
