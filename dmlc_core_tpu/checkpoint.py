@@ -633,14 +633,11 @@ class Checkpointer:
         handle = self._inflight
         if handle is None:
             return
-        finished = handle._done.wait(timeout)
-        if not finished:
-            raise Error(
-                f"checkpoint write still in flight after {timeout}s"
-            )
-        self._inflight = None
-        if handle._exc is not None:
-            raise handle._exc
+        try:
+            handle.result(timeout)  # raises on timeout or write failure
+        finally:
+            if handle.done():
+                self._inflight = None
 
     def save_async(self, step: int, tree: Any) -> AsyncSave:
         """Checkpoint with the file I/O overlapped against training.
@@ -677,6 +674,25 @@ class Checkpointer:
             except ImportError:
                 proc = 0 if proc is None else proc
                 count = 1 if count is None else count
+            if count > 1:
+                # the background barriers NEED the jax coordination
+                # service; tracker-launched workers (jax not distributed)
+                # cannot bracket a background write with their own
+                # barrier, so fail at CALL time with the fix, not with a
+                # torn checkpoint later
+                try:
+                    import jax
+
+                    jax_procs = jax.process_count()
+                except ImportError:
+                    jax_procs = 1
+                check(
+                    jax_procs > 1,
+                    "save_async with process_count > 1 requires "
+                    "jax.distributed.initialize (coordination-service "
+                    "barriers); tracker-launched workers should use the "
+                    "synchronous save() with an external barrier",
+                )
             path = self._path(step, sharded=True)
             skeleton, chunks = _snapshot_sharded(tree)  # caller thread
 

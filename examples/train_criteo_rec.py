@@ -99,8 +99,13 @@ def main() -> None:
     # without one, fall back to sequential byte-sharded reads
     has_index = os.path.exists(path + ".idx")
     for epoch in range(first_epoch, first_epoch + 3):
+        # shuffle=batch: permuted SPANS of batch_size records, one
+        # coalesced seek per span — sequential-read throughput at
+        # shuffle granularity batch_size (shuffle=1 would be the
+        # reference's per-record-seek full permutation)
         uri = (
-            f"{path}?index={path}.idx&shuffle=1&seed={epoch + 1}"
+            f"{path}?index={path}.idx&shuffle=batch&batch_size=2048"
+            f"&seed={epoch + 1}"
             if has_index
             else path
         )
@@ -118,7 +123,11 @@ def main() -> None:
         )
         stream.close()
         pipe.close()
-        ck.save(epoch, params)
+        # async: the write overlaps the next epoch's training; ck.save/
+        # restore/wait all drain it, and the final wait() below surfaces
+        # any background write failure before we declare success
+        ck.save_async(epoch, params)
+    ck.wait()
     print("latest checkpoint step:", ck.latest_step())
     if worker is not None:
         worker.shutdown()

@@ -211,3 +211,76 @@ def test_ell_index_dtype_overflow_guard():
     )
     with pytest.raises(Exception, match="does not fit"):
         list(FixedShapeBatcher(spec_err).push(blk))
+
+
+# -- fault injection (SURVEY §7 step 7: fault-injection producers) -----------
+
+class _FaultyProducer:
+    """Yields ``good`` real batches then raises — the disk/parse failure
+    modes (IO error, corrupt shard) surfacing mid-epoch inside the
+    prefetch thread."""
+
+    def __init__(self, good: int, exc: Exception):
+        self.good = good
+        self.exc = exc
+        self.closed = False
+
+    def __iter__(self):
+        spec = BatchSpec(batch_size=2, layout="ell", max_nnz=3)
+        b = FixedShapeBatcher(spec)
+        for i in range(self.good):
+            for out in b.push(ragged_block([1, 2], base=2 * i)):
+                yield out
+        raise self.exc
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.mark.jax
+def test_pipeline_propagates_producer_fault_midstream():
+    """A producer raising mid-epoch (after real batches staged) must
+    surface THAT exception to the consumer — not hang the prefetch
+    thread, not truncate silently — and the pipeline must still close."""
+    boom = OSError("disk died mid-shard")
+    prod = _FaultyProducer(good=3, exc=boom)
+    pipe = StagingPipeline(prod)
+    staged = []
+    with pytest.raises(OSError, match="disk died"):
+        for dev in pipe:
+            staged.append(np.asarray(dev["labels"]))
+    # batches already handed out arrived intact; the batch still in
+    # flight behind the fault is dropped WITH the exception (the epoch is
+    # poisoned — consumers restart from checkpoint, never trust a tail)
+    assert len(staged) >= 2
+    for i, lab in enumerate(staged):
+        np.testing.assert_array_equal(lab, [2 * i, 2 * i + 1])
+    pipe.close()  # must not wedge on the dead prefetch thread
+    prod.close()
+
+
+@pytest.mark.jax
+def test_pipeline_fault_before_first_batch():
+    boom = ValueError("corrupt header")
+    pipe = StagingPipeline(_FaultyProducer(good=0, exc=boom))
+    with pytest.raises(ValueError, match="corrupt header"):
+        next(iter(pipe))
+    pipe.close()
+
+
+@pytest.mark.jax
+def test_pipeline_abandoned_mid_epoch_closes_clean():
+    """A consumer that stops pulling (early stopping, crash-unwind) and
+    closes must not deadlock against a full prefetch queue."""
+    spec = BatchSpec(batch_size=2, layout="ell", max_nnz=3)
+    b = FixedShapeBatcher(spec)
+    blocks = [ragged_block([1, 2], base=2 * i) for i in range(50)]
+
+    def gen():
+        for blk in blocks:
+            yield from b.push(blk)
+
+    pipe = StagingPipeline(gen())
+    it = iter(pipe)
+    next(it)  # stage one batch, then abandon with the queue primed
+    pipe.close()
