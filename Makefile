@@ -16,7 +16,7 @@ PY ?= python
 LIBASAN := $(shell gcc -print-file-name=libasan.so)
 LIBTSAN := $(shell gcc -print-file-name=libtsan.so)
 # the suites that exercise the native .so (what the sanitizers can see)
-NATIVE_TESTS := tests/test_native.py tests/test_fused.py tests/test_rowrec.py tests/test_libfm_ell.py
+NATIVE_TESTS := tests/test_native.py tests/test_fused.py tests/test_rowrec.py tests/test_libfm_ell.py tests/test_libsvm_ell.py
 
 .PHONY: check lint native test sanitizers dryrun bench clean
 

@@ -55,11 +55,20 @@ _LEAF_KEY = "__dmlc_sharded_leaf__"
 _MANIFEST = "MANIFEST.bin"
 
 
-def _to_host(tree: Any) -> Any:
-    """jax arrays → numpy (device→host); leaves numpy/scalars alone."""
+def _to_host(tree: Any, copy: bool = False) -> Any:
+    """jax arrays → numpy (device→host); leaves numpy/scalars alone.
+
+    ``copy``: force OWNED buffers for every array leaf — the async path
+    needs it because numpy leaves pass through by reference and a CPU
+    backend's np.asarray can be a zero-copy view; without the copy a
+    background serialization races in-place mutation of the caller's
+    arrays (torn checkpoint)."""
     def conv(x):
-        if hasattr(x, "__array__") and not isinstance(x, np.ndarray):
-            return np.asarray(x)
+        if isinstance(x, np.ndarray):
+            return np.array(x, copy=True) if copy else x
+        if hasattr(x, "__array__"):
+            arr = np.asarray(x)
+            return np.array(arr, copy=True) if copy else arr
         return x
 
     return _tree_map(conv, tree)
@@ -224,12 +233,14 @@ def save_pytree_sharded(
     )
 
 
-def _snapshot_sharded(tree: Any):
+def _snapshot_sharded(tree: Any, copy: bool = False):
     """Device→host snapshot: skeleton + this process's replica-0 chunks.
 
     Runs in the CALLER's thread — after it returns, the checkpoint no
     longer references device buffers, so training may donate/overwrite
-    params while a background thread does the file I/O (the async path).
+    params while a background thread does the file I/O (the async path,
+    which passes ``copy=True``: on CPU backends np.asarray of a shard
+    can be a zero-copy view, and inline host leaves pass by reference).
     """
     leaves: List[Any] = []
 
@@ -250,6 +261,8 @@ def _snapshot_sharded(tree: Any):
                 "shape": [int(d) for d in x.shape],
                 "dtype": str(x.dtype),
             }
+        if copy and isinstance(x, np.ndarray):
+            return np.array(x, copy=True)  # inline host leaf: own it
         return x
 
     def walk(t):
@@ -270,7 +283,10 @@ def _snapshot_sharded(tree: Any):
             if shard.replica_id != 0:
                 continue
             starts, stops = _norm_index(shard.index, arr.shape)
-            mine.append((starts, stops, np.asarray(shard.data)))
+            data = np.asarray(shard.data)
+            if copy:
+                data = np.array(data, copy=True)
+            mine.append((starts, stops, data))
         if mine:
             chunks[leaf_id] = mine
     return skeleton, chunks
@@ -575,23 +591,32 @@ class Checkpointer:
             return False
         return any(info.path.rstrip("/").endswith(_MANIFEST) for info in listing)
 
-    def steps(self) -> List[int]:
+    def _scan(self) -> Dict[int, bool]:
+        """One base listing → {step: has_complete_sharded_dir}.
+
+        The single source for step discovery AND layout choice, so
+        save/restore/steps don't each re-probe the (possibly remote)
+        directory: per call, one LIST of the base plus one LIST per .d
+        entry (bounded by ``keep``+in-progress, not history)."""
         try:
             listing = self._fs().list_directory(self.base)
         except (OSError, Error):
-            return []
-        out = []
+            return {}
+        out: Dict[int, bool] = {}
         for info in listing:
             m = self._PAT.search(info.path.rstrip("/"))
             if not m:
                 continue
             step = int(m.group(1))
-            if m.group(2) == ".d" and not self._manifest_ok(
-                self._path(step, sharded=True)
-            ):
-                continue  # torn/in-progress sharded checkpoint
-            out.append(step)
-        return sorted(set(out))
+            if m.group(2) == ".bin":
+                out.setdefault(step, False)
+            elif self._manifest_ok(self._path(step, sharded=True)):
+                out[step] = True
+            # torn .d with no .bin stays invisible
+        return out
+
+    def steps(self) -> List[int]:
+        return sorted(self._scan())
 
     def latest_step(self) -> Optional[int]:
         steps = self.steps()
@@ -694,7 +719,9 @@ class Checkpointer:
                     "synchronous save() with an external barrier",
                 )
             path = self._path(step, sharded=True)
-            skeleton, chunks = _snapshot_sharded(tree)  # caller thread
+            # owned buffers (copy=True): donation-safe AND immune to
+            # zero-copy views on CPU backends
+            skeleton, chunks = _snapshot_sharded(tree, copy=True)
 
             def work():
                 _write_sharded(
@@ -710,8 +737,8 @@ class Checkpointer:
                     )
                 return path
         else:
-            host_tree = _to_host(tree)  # caller thread: donation-safe
-            path = self._path(step)
+            # owned host buffers: donation- AND in-place-mutation-safe
+            host_tree = _to_host(tree, copy=True)  # caller thread
             is_writer = self._is_writer()
 
             def work():
@@ -719,16 +746,7 @@ class Checkpointer:
                     # same contract as sync save(): None on non-writers —
                     # the URI is only meaningful where the file exists
                     return None
-                sharded_path = self._path(step, sharded=True)
-                if self._manifest_ok(sharded_path):
-                    _clear_manifest(sharded_path)
-                    _write_atomic(path, host_tree)
-                    _remove_uri(sharded_path, tree_ok=True)
-                else:
-                    _write_atomic(path, host_tree)
-                self._prune()
-                log_info(f"async checkpoint step {step} -> {path}")
-                return path
+                return self._write_single(step, host_tree, tag="async ")
 
         def run():
             try:
@@ -765,11 +783,18 @@ class Checkpointer:
             return path
         if not self._is_writer():
             return None
-        # a same-step sharded .d would SHADOW the new .bin (restore
-        # prefers .d): tear it (manifest first, STRICTLY — a surviving
-        # stale manifest would shadow the new data forever), write the
-        # .bin, then clear the debris. Gated on actual presence so the
-        # common no-.d case costs no extra round trips.
+        return self._write_single(step, tree)
+
+    def _write_single(self, step: int, tree: Any, tag: str = "") -> str:
+        """Single-file (.bin) write + same-step shadow invalidation +
+        retention — shared by sync save() and the async worker so the
+        tear ordering can never diverge between them.
+
+        A same-step sharded .d would SHADOW the new .bin (restore
+        prefers .d): tear it (manifest first, STRICTLY — a surviving
+        stale manifest would shadow the new data forever), write the
+        .bin, then clear the debris. Gated on actual presence so the
+        common no-.d case costs no extra round trips."""
         sharded_path = self._path(step, sharded=True)
         had_shadow = self._manifest_ok(sharded_path)
         if had_shadow:
@@ -779,7 +804,7 @@ class Checkpointer:
         if had_shadow:
             _remove_uri(sharded_path, tree_ok=True)
         self._prune()
-        log_info(f"checkpoint step {step} -> {path}")
+        log_info(f"{tag}checkpoint step {step} -> {path}")
         return path
 
     def restore(
@@ -791,13 +816,15 @@ class Checkpointer:
         whose shardings say where each restored leaf should live on the
         CURRENT mesh (resharding restore). Applies to both layouts."""
         self.wait()  # never read past an in-flight write
+        scan = self._scan()
         if step is None:
-            step = self.latest_step()
-            check(step is not None, f"no checkpoints under {self.base}")
+            check(bool(scan), f"no checkpoints under {self.base}")
+            step = max(scan)
         step = int(step)
-        sharded_path = self._path(step, sharded=True)
-        if self._manifest_ok(sharded_path):
-            return step, load_pytree_sharded(sharded_path, template)
+        if scan.get(step, False):
+            return step, load_pytree_sharded(
+                self._path(step, sharded=True), template
+            )
         tree = load_pytree(self._path(step))
         if template is not None:
             tree = _tree_map2(

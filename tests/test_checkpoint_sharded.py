@@ -276,6 +276,21 @@ def test_async_save_overlaps_and_restores(tmp_path):
     assert ck.steps() == [5, 6, 7]  # keep=3 pruned step 4
 
 
+def test_async_save_snapshots_host_leaves(tmp_path):
+    """In-place mutation of numpy leaves right after save_async returns
+    must not leak into the background write (the snapshot owns its
+    buffers — torn-checkpoint hazard otherwise)."""
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    counter = np.zeros(4, np.float32)
+    ck = Checkpointer(str(tmp_path / "ck"), process_index=0, sharded=False)
+    handle = ck.save_async(1, {"counter": counter})
+    counter += 99.0  # "next step" mutates host state in place
+    handle.result(timeout=30)
+    _, back = ck.restore()
+    np.testing.assert_array_equal(back["counter"], np.zeros(4))
+
+
 def test_async_save_failure_surfaces(tmp_path):
     from dmlc_core_tpu.checkpoint import Checkpointer
     from dmlc_core_tpu.utils.logging import Error as DmlcError

@@ -252,11 +252,36 @@ class FakeS3Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    BATCH_DELETES = 0
+
     def do_POST(self):
         if not self._check_auth():
             return
         key, q = self._key()
-        self._body()
+        body_bytes = self._body()
+        if "delete" in q:
+            # DeleteObjects: Content-MD5 mandatory, like real S3
+            import base64 as b64mod
+            import xml.etree.ElementTree as ETmod
+
+            want = b64mod.b64encode(
+                hashlib.md5(body_bytes).digest()
+            ).decode()
+            if self.headers.get("Content-MD5") != want:
+                self.send_error(400, "InvalidDigest")
+                return
+            type(self).BATCH_DELETES += 1
+            bucket = key.split("/", 1)[0]
+            root = ETmod.fromstring(body_bytes)
+            for obj in root.iter("Object"):
+                k = obj.findtext("Key") or ""
+                self.STORE.pop(f"{bucket}/{k}", None)
+            out = b"<DeleteResult/>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+            return
         if "uploads" in q:
             uid = f"upl{len(self.UPLOADS)}"
             self.UPLOADS[uid] = {}
@@ -425,20 +450,24 @@ def test_s3_write_read_roundtrip(s3):
 
 
 def test_s3_delete_object_and_prefix(s3):
+    FakeS3Handler.BATCH_DELETES = 0
     FakeS3Handler.STORE.update(
         {
             "bkt/ck/a.bin": b"a",
             "bkt/ck/sub/b.bin": b"b",
+            "bkt/ck/sub/c d+e.bin": b"c",  # key needing XML/URL care
             "bkt/keep.txt": b"k",
         }
     )
     fs = FileSystem.get_instance("s3://bkt/ck")
     fs.delete("s3://bkt/ck/a.bin")
     assert "bkt/ck/a.bin" not in FakeS3Handler.STORE
-    # recursive prefix sweep (checkpoint retention on object stores)
+    # recursive prefix sweep rides ONE DeleteObjects POST, not
+    # per-object round trips (checkpoint retention on object stores)
     fs.delete("s3://bkt/ck", recursive=True)
     assert [k for k in FakeS3Handler.STORE if k.startswith("bkt/ck")] == []
     assert "bkt/keep.txt" in FakeS3Handler.STORE
+    assert FakeS3Handler.BATCH_DELETES == 1
 
 
 def test_s3_multipart_upload(s3, monkeypatch):
