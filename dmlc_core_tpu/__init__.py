@@ -54,6 +54,7 @@ def build_info() -> dict:
             "csv_dense": native.HAS_CSV_DENSE,
             "rowrec_ell": native.HAS_ELL,
             "libfm_ell": native.HAS_LIBFM_ELL,
+            "libsvm_ell": native.HAS_LIBSVM_ELL,
         },
         "env": {
             k: os.environ[k]

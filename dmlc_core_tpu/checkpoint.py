@@ -591,32 +591,76 @@ class Checkpointer:
             return False
         return any(info.path.rstrip("/").endswith(_MANIFEST) for info in listing)
 
-    def _scan(self) -> Dict[int, bool]:
-        """One base listing → {step: has_complete_sharded_dir}.
+    def _scan_ex(self) -> Dict[int, Dict[str, Any]]:
+        """One base listing → {step: {sharded, bytes}}.
 
         The single source for step discovery AND layout choice, so
         save/restore/steps don't each re-probe the (possibly remote)
         directory: per call, one LIST of the base plus one LIST per .d
-        entry (bounded by ``keep``+in-progress, not history)."""
+        entry (bounded by ``keep``+in-progress, not history) — that .d
+        listing answers BOTH manifest presence and the byte total."""
         try:
             listing = self._fs().list_directory(self.base)
         except (OSError, Error):
             return {}
-        out: Dict[int, bool] = {}
+        out: Dict[int, Dict[str, Any]] = {}
         for info in listing:
             m = self._PAT.search(info.path.rstrip("/"))
             if not m:
                 continue
             step = int(m.group(1))
             if m.group(2) == ".bin":
-                out.setdefault(step, False)
-            elif self._manifest_ok(self._path(step, sharded=True)):
-                out[step] = True
+                out.setdefault(
+                    step, {"sharded": False, "bytes": int(info.size)}
+                )
+                continue
+            try:
+                entries = self._fs().list_directory(
+                    self._path(step, sharded=True)
+                )
+            except (OSError, Error):
+                continue
+            if any(e.path.rstrip("/").endswith(_MANIFEST) for e in entries):
+                out[step] = {
+                    "sharded": True,
+                    "bytes": sum(int(e.size) for e in entries),
+                }
             # torn .d with no .bin stays invisible
         return out
 
+    def _scan(self) -> Dict[int, bool]:
+        return {s: v["sharded"] for s, v in self._scan_ex().items()}
+
     def steps(self) -> List[int]:
         return sorted(self._scan())
+
+    def steps_info(self) -> List[Dict[str, Any]]:
+        """Public inspection: [{step, layout, uri, bytes}] sorted by step
+        (the `tools ckpt` surface — one listing pass, see _scan_ex)."""
+        out = []
+        for step, v in sorted(self._scan_ex().items()):
+            out.append({
+                "step": step,
+                "layout": "sharded" if v["sharded"] else "single",
+                "uri": self._path(step, sharded=v["sharded"]),
+                "bytes": v["bytes"],
+            })
+        return out
+
+    def prune(self, keep: Optional[int] = None) -> List[int]:
+        """Public retention pass; returns the steps removed. ``keep``
+        overrides the configured count for this call; keep <= 0 disables
+        pruning (same semantics as the constructor's keep)."""
+        old = self.keep
+        if keep is not None:
+            self.keep = keep
+        try:
+            before = self.steps()
+            self._prune()
+            after = set(self.steps())
+        finally:
+            self.keep = old
+        return [s for s in before if s not in after]
 
     def latest_step(self) -> Optional[int]:
         steps = self.steps()

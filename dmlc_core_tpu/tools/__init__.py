@@ -235,6 +235,50 @@ def _cmd_info(args) -> int:
     return 0
 
 
+def _cmd_ckpt(args) -> int:
+    """Operator surface for checkpoint directories: list steps with
+    layout/size, inspect a step's tree shapes, prune to a retention
+    count — over any URI backend (the reference leaves this to shell
+    scripts against local disk). Uses only Checkpointer's public API
+    (steps_info/restore/prune)."""
+    import json
+
+    from ..checkpoint import Checkpointer
+    from ..utils.logging import Error as DmlcError
+
+    ck = Checkpointer(args.base)
+    if args.action == "ls":
+        print(json.dumps(ck.steps_info(), indent=2))
+        return 0
+    if args.action == "show":
+        try:
+            step, tree = ck.restore(args.step)
+        except (DmlcError, OSError) as e:
+            sys.stderr.write(
+                f"error: no readable checkpoint "
+                f"{'step %s ' % args.step if args.step is not None else ''}"
+                f"under {args.base}: {e}\n"
+            )
+            return 1
+
+        def describe(t):
+            if isinstance(t, dict):
+                return {k: describe(v) for k, v in t.items()}
+            if isinstance(t, (list, tuple)):
+                return [describe(v) for v in t]
+            if hasattr(t, "shape") and hasattr(t, "dtype"):
+                return f"{t.dtype}{list(t.shape)}"
+            return repr(t)
+
+        print(json.dumps({"step": step, "tree": describe(tree)}, indent=2))
+        return 0
+    # prune: --keep passes through VERBATIM — keep <= 0 means retention
+    # disabled (Checkpointer semantics), never a silent default
+    removed = ck.prune(keep=args.keep)
+    print(json.dumps({"kept": ck.steps(), "removed": removed}))
+    return 0
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m dmlc_core_tpu.tools",
@@ -303,6 +347,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
     info = sub.add_parser("info", help="runtime feature report (JSON)")
     info.set_defaults(fn=_cmd_info)
+
+    ck = sub.add_parser(
+        "ckpt", help="inspect/prune checkpoint directories (any URI)"
+    )
+    ck.add_argument("action", choices=["ls", "show", "prune"])
+    ck.add_argument("base", help="checkpoint base URI")
+    ck.add_argument("--step", type=int, default=None,
+                    help="step for 'show' (default: newest)")
+    ck.add_argument("--keep", type=int, default=3,
+                    help="retention count for 'prune'")
+    ck.set_defaults(fn=_cmd_ckpt)
     return p
 
 

@@ -236,8 +236,10 @@ def test_info_reports_features(capsys):
     assert info["native_available"] == native_mod.AVAILABLE
     assert info["fused_kernels"]["libfm_ell"] == native_mod.HAS_LIBFM_ELL
     assert set(info["fused_kernels"]) == {
-        "libsvm_dense", "csv_dense", "rowrec_ell", "libfm_ell"
+        "libsvm_dense", "csv_dense", "rowrec_ell", "libfm_ell",
+        "libsvm_ell",
     }
+    assert info["fused_kernels"]["libsvm_ell"] == native_mod.HAS_LIBSVM_ELL
 
 
 def test_bad_shard_args_are_cli_errors(libsvm_file, tmp_path, capsys):
@@ -253,3 +255,65 @@ def test_bad_shard_args_are_cli_errors(libsvm_file, tmp_path, capsys):
         assert rc == 1 and "invalid shard" in err, (extra, err)
     rc, _, err = run_cli(["split", libsvm_file, "2", "2"], capsys)
     assert rc == 1 and "invalid shard" in err
+
+
+def test_ckpt_ls_show_prune(tmp_path, capsys):
+    """tools ckpt: list steps with layout, inspect a tree's shapes,
+    prune to a retention count — over both checkpoint layouts."""
+    import json
+
+    import numpy as np
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    base = str(tmp_path / "cks")
+    ck = Checkpointer(base, keep=10, process_index=0)
+    for s in (1, 2, 3):
+        ck.save(s, {"w": np.full((4, 2), s, np.float32), "step": s})
+
+    rc, out, _ = run_cli(["ckpt", "ls", base], capsys)
+    listing = json.loads(out)
+    assert rc == 0 and [e["step"] for e in listing] == [1, 2, 3]
+    assert all(e["layout"] == "single" and e["bytes"] > 0 for e in listing)
+
+    rc, out, _ = run_cli(["ckpt", "show", base], capsys)
+    shown = json.loads(out)
+    assert rc == 0 and shown["step"] == 3
+    assert shown["tree"]["w"] == "float32[4, 2]"
+
+    rc, out, _ = run_cli(["ckpt", "show", base, "--step", "1"], capsys)
+    assert json.loads(out)["step"] == 1
+
+    # --keep 0 disables pruning (Checkpointer semantics), never a
+    # silent destructive default
+    rc, out, _ = run_cli(["ckpt", "prune", base, "--keep", "0"], capsys)
+    pruned = json.loads(out)
+    assert rc == 0 and pruned["kept"] == [1, 2, 3] and pruned["removed"] == []
+
+    rc, out, _ = run_cli(["ckpt", "prune", base, "--keep", "2"], capsys)
+    pruned = json.loads(out)
+    assert rc == 0 and pruned["kept"] == [2, 3] and pruned["removed"] == [1]
+
+    rc, out, err = run_cli(["ckpt", "show", base, "--step", "9"], capsys)
+    assert rc == 1 and "error:" in err and "step 9" in err
+
+    rc, out, err = run_cli(
+        ["ckpt", "show", str(tmp_path / "empty")], capsys
+    )
+    assert rc == 1 and "error:" in err and "None" not in err
+
+
+def test_ckpt_ls_sharded_layout(tmp_path, capsys):
+    import json
+
+    import numpy as np
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    base = str(tmp_path / "cks")
+    Checkpointer(base, sharded=True).save(7, {"w": np.ones(6, np.float32)})
+    rc, out, _ = run_cli(["ckpt", "ls", base], capsys)
+    (entry,) = json.loads(out)
+    assert rc == 0 and entry["layout"] == "sharded" and entry["step"] == 7
+    rc, out, _ = run_cli(["ckpt", "show", base], capsys)
+    assert json.loads(out)["tree"]["w"] == "float32[6]"
