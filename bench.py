@@ -588,6 +588,13 @@ def main() -> None:
     host_higgs = med("higgs_host")
     rec_med = med("rec_f16")
     host_rec = med("rec_host")
+    # medians are the honest headline on a link that throttles under
+    # sustained transfer; per-task bests record what an unthrottled
+    # window achieves (and keep r1-r3 best-of numbers comparable)
+    best = {
+        name: round(max(r["rows_per_sec"] for r in runs), 1)
+        for name, runs in series.items()
+    }
 
     # measurement invariants (VERDICT r3 #6): a staged pipeline cannot
     # out-run its own parser measured in the same window; the link
@@ -609,9 +616,7 @@ def main() -> None:
                 "value": value,
                 "unit": "rows/sec",
                 "vs_baseline": round(value / 1_000_000, 4),
-                "best_rows_per_sec": round(
-                    max(r["rows_per_sec"] for r in series["higgs_f16"]), 1
-                ),
+                "best_rows_per_sec": best["higgs_f16"],
                 "f32_rows_per_sec": med("higgs_f32"),
                 "recordio_staged_rows_per_sec": rec_med,
                 "recordio_staged_mb_per_sec": med("rec_f16", "mb_per_sec"),
@@ -630,6 +635,7 @@ def main() -> None:
                 "infeed_utilization": round(infeed_utilization, 4),
                 "invariants_ok": not failures,
                 "invariant_failures": failures,
+                "best": best,
                 "native": native.AVAILABLE,
                 "fused_dense_kernel": native.HAS_DENSE,
                 "fused_ell_kernel": native.HAS_ELL,
