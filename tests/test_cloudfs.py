@@ -716,6 +716,97 @@ def test_gcs_falls_back_anonymous_off_gce(monkeypatch):
         gcs.stop()
 
 
+def test_gcs_adc_checkpoint_lifecycle(gcs_adc, monkeypatch):
+    """The TPU-VM deployment story end to end: Checkpointer over gs://
+    with metadata-server credentials — save, list, restore, retention
+    (DELETEs ride the same Bearer auth)."""
+    import numpy as np
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+
+    meta, gcs = gcs_adc
+    # extend the Bearer fake with enough surface for checkpoints
+    store = FakeGcsBearerHandler.STORE
+
+    def do_PUT(self):
+        if not self._authed():
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        store[self._key()] = self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        if not self._authed():
+            return
+        store.pop(self._key(), None)
+        self.send_response(204)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        if "?" in self.path and "list-type" in self.path:
+            if not self._authed():
+                return
+            # minimal ListObjectsV2 over the flat store
+            q = urllib.parse.parse_qs(self.path.split("?", 1)[1])
+            prefix = q.get("prefix", [""])[0]
+            delim = q.get("delimiter", [""])[0]
+            bucket = self.path.lstrip("/").split("?", 1)[0].rstrip("/")
+            keys = [k[len(bucket) + 1:] for k in store
+                    if k.startswith(f"{bucket}/{prefix}")]
+            contents, prefixes = [], set()
+            for k in keys:
+                rest = k[len(prefix):]
+                if delim and delim in rest:
+                    prefixes.add(prefix + rest.split(delim, 1)[0] + delim)
+                else:
+                    contents.append(k)
+            body = (
+                "<ListBucketResult>"
+                + "".join(
+                    f"<Contents><Key>{k}</Key><Size>"
+                    f"{len(store[f'{bucket}/{k}'])}</Size></Contents>"
+                    for k in contents
+                )
+                + "".join(
+                    f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>"
+                    for p in sorted(prefixes)
+                )
+                + "<IsTruncated>false</IsTruncated></ListBucketResult>"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        type(self)._plain_get(self)
+
+    monkeypatch.setattr(FakeGcsBearerHandler, "_plain_get",
+                        FakeGcsBearerHandler.do_GET, raising=False)
+    monkeypatch.setattr(FakeGcsBearerHandler, "do_GET", do_GET)
+    monkeypatch.setattr(FakeGcsBearerHandler, "do_PUT", do_PUT,
+                        raising=False)
+    monkeypatch.setattr(FakeGcsBearerHandler, "do_DELETE", do_DELETE,
+                        raising=False)
+
+    ck = Checkpointer("gs://bkt/run", keep=2, process_index=0)
+    for s in (1, 2, 3):
+        ck.save(s, {"w": np.full(4, s, np.float32)})
+    assert ck.steps() == [2, 3]  # retention deleted step 1 over Bearer
+    step, tree = ck.restore()
+    assert step == 3
+    np.testing.assert_array_equal(tree["w"], np.full(4, 3.0))
+    # EVERY request rode Bearer auth — no `if a` filter: ALLOW_ANON is
+    # False, so an anonymous request is never legitimate here and must
+    # fail this assertion, not be exempted from it
+    assert FakeGcsBearerHandler.SAW_AUTH
+    assert all(
+        a == "Bearer meta-token-1" for a in FakeGcsBearerHandler.SAW_AUTH
+    )
+
+
 class FakeTokenEndpointHandler(BaseHTTPRequestHandler):
     """OAuth2 token endpoint verifying the RS256 jwt-bearer assertion
     against the test keypair before minting a token."""
