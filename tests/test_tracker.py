@@ -430,6 +430,35 @@ def test_stalling_client_does_not_serialize_rendezvous():
     tracker.close()
 
 
+@pytest.mark.slow
+def test_pod_scale_rendezvous_64_workers():
+    """64 workers rendezvous concurrently (in-process pod-scale smoke):
+    unique ranks, every tree+ring link wired, clean shutdown. The r3
+    serial tracker brokered these one at a time; the broker pool runs
+    non-adjacent sessions in parallel."""
+    n = 64
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+    t0 = time.time()
+    results = run_workers(tracker, n)
+    elapsed = time.time() - t0
+    tracker.join()
+    tracker.close()
+    assert sorted(r[0] for r in results) == list(range(n))
+    tree_map, _parent, _ring = topology.get_link_map(n)
+    for rank, _parent_r, world, links, rprev, rnext in results:
+        assert world == n
+        expected = set(tree_map[rank])
+        if rprev not in (-1, rank):
+            expected.add(rprev)
+        if rnext not in (-1, rank):
+            expected.add(rnext)
+        assert set(links) == expected, (rank, links, expected)
+    # not a benchmark, but a 64-node rendezvous that takes minutes means
+    # the brokering serialized somewhere it shouldn't
+    assert elapsed < 60, f"rendezvous took {elapsed:.1f}s"
+
+
 def test_close_terminates_state_thread():
     """tracker.close() must stop the state thread even with the job
     incomplete (submit()'s abort path relies on it; the state thread
