@@ -1,0 +1,125 @@
+"""Diagnostic: attribute the rowrec→HBM infeed gap (VERDICT r4 weak #1).
+
+Runs the rec f16 staged epoch with per-stage timing, then isolates each
+suspect cost on the same host/device:
+
+  A. staged epoch w/ stage breakdown (host_pull / stage_dispatch / wait)
+  B. device_put-only of the packed buffers (no jit unpack)
+  C. device_put + jit unpack (the production stage_batch path)
+  D. raw probe (prestaged random buffers, same shape/depth)
+  E. host-only parse epoch (fused producer, no device)
+
+Prints one JSON blob. Not part of the bench contract; a scalpel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+import bench  # reuse data generators + stream makers
+
+
+def staged_epoch():
+    import jax
+
+    from dmlc_core_tpu.staging import StagingPipeline
+
+    stream, key, _ = bench._make_rec_stream("float16")
+    t0 = time.perf_counter()
+    pipe = StagingPipeline(stream, depth=3)
+    last = None
+    n = 0
+    for dev in pipe:
+        last = dev
+        n += 1
+    if last is not None:
+        jax.block_until_ready(last[key])
+    dt = time.perf_counter() - t0
+    out = {
+        "secs": dt,
+        "rows_per_sec": pipe.rows_staged / dt,
+        "batches": n,
+        **{k: round(v, 4) for k, v in pipe.stage_seconds.items()},
+    }
+    stream.close()
+    pipe.close()
+    return out
+
+
+def packed_sizes():
+    stream, _key, _ = bench._make_rec_stream("float16")
+    sizes = []
+    for b in stream:
+        sizes.append(b.packed.nbytes if b.packed is not None else -1)
+        if len(sizes) >= 2:
+            break
+    stream.close()
+    return sizes
+
+
+def put_only_epoch(unpack: bool):
+    """Parse on host into ring slots, device_put each packed buffer
+    (optionally + jit unpack) with depth-3 in-flight, block in order.
+    Isolates transfer+dispatch from the pipeline's thread plumbing."""
+    import jax
+
+    from dmlc_core_tpu.staging.pipeline import (
+        _packed_layout,
+        _safe_host,
+        _unpacker,
+    )
+
+    stream, _key, _ = bench._make_rec_stream("float16")
+    dev = jax.local_devices()[0]
+    t0 = time.perf_counter()
+    inflight = []
+    n = 0
+    rows = 0
+    for b in stream:
+        if b.packed is None:
+            raise RuntimeError("no packed buffer")
+        u8 = jax.device_put(_safe_host(b.packed, dev.platform), dev)
+        if unpack:
+            layout = _packed_layout(b)
+            u8 = _unpacker(layout, dev.platform)(u8)
+        inflight.append(u8)
+        n += 1
+        rows += b.n_valid
+        if len(inflight) >= 3:
+            jax.block_until_ready(inflight.pop(0))
+    for x in inflight:
+        jax.block_until_ready(x)
+    dt = time.perf_counter() - t0
+    stream.close()
+    return {"secs": dt, "rows_per_sec": rows / dt, "batches": n}
+
+
+def main():
+    bench.ensure_native()
+    bench.ensure_rec_data()
+    import jax
+
+    jax.local_devices()  # warm the backend outside any timer
+    out = {}
+    out["packed_nbytes"] = packed_sizes()
+    # interleave two rounds so throttle hits everything equally
+    for r in range(2):
+        out[f"A_staged_{r}"] = staged_epoch()
+        out[f"B_put_only_{r}"] = put_only_epoch(unpack=False)
+        out[f"C_put_unpack_{r}"] = put_only_epoch(unpack=True)
+        out[f"E_host_only_{r}"] = bench.host_epoch(bench._make_rec_stream)
+        nb = out["packed_nbytes"][0]
+        nbatches = out[f"A_staged_{r}"]["batches"]
+        out[f"D_raw_{r}"] = bench.raw_infeed_probe(nb, nbatches)
+    print(json.dumps(out, indent=1, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Characterize the tunneled host->TPU link: sustained rate, burst
+size, per-put latency series. 60 puts x 8MB = ~480MB over whatever time
+it takes."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    jax.local_devices()
+    rng = np.random.default_rng(5)
+    NB = 8060928
+    bufs = [rng.integers(0, 255, NB, dtype=np.uint8) for _ in range(8)]
+    N = 60
+    times = []
+    t_all = time.perf_counter()
+    for i in range(N):
+        t0 = time.perf_counter()
+        d = jax.device_put(bufs[i % len(bufs)])
+        jax.block_until_ready(d)
+        times.append(round(time.perf_counter() - t0, 4))
+    dt = time.perf_counter() - t_all
+    mb = NB / 1e6
+    print(json.dumps({
+        "total_secs": round(dt, 2),
+        "sustained_mb_per_sec": round(NB * N / dt / 1e6, 1),
+        "per_put_mb_per_sec": [round(mb / t, 1) for t in times],
+        "per_put_secs": times,
+    }, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+"""Isolate device_put behavior on this platform: distinct vs reused
+buffers, dispatch-blocking vs async, and per-call latency."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+def put_series(bufs, n, depth=3):
+    import jax
+
+    inflight = []
+    t0 = time.perf_counter()
+    dispatch = 0.0
+    for i in range(n):
+        td = time.perf_counter()
+        inflight.append(jax.device_put(bufs[i % len(bufs)]))
+        dispatch += time.perf_counter() - td
+        if len(inflight) >= depth:
+            jax.block_until_ready(inflight.pop(0))
+    for d in inflight:
+        jax.block_until_ready(d)
+    dt = time.perf_counter() - t0
+    nb = bufs[0].nbytes * n
+    return {
+        "secs": round(dt, 4),
+        "dispatch_secs": round(dispatch, 4),
+        "mb_per_sec": round(nb / dt / 1e6, 1),
+    }
+
+
+def main():
+    import jax
+
+    jax.local_devices()
+    rng = np.random.default_rng(3)
+    NB = 8060928
+    N = 13
+    distinct = [rng.integers(0, 255, NB, dtype=np.uint8) for _ in range(N)]
+    ring3 = distinct[:3]
+    one = distinct[:1]
+    out = {"platform": jax.local_devices()[0].platform}
+    for r in range(3):
+        out[f"distinct13_{r}"] = put_series(distinct, N)
+        out[f"ring3_{r}"] = put_series(ring3, N)
+        out[f"same1_{r}"] = put_series(one, N)
+        # fresh buffers every call (realloc) — matches what a
+        # copy-on-stage producer would do
+        fresh = [
+            rng.integers(0, 255, NB, dtype=np.uint8) for _ in range(N)
+        ]
+        out[f"fresh13_{r}"] = put_series(fresh, N)
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Does host CPU work between puts starve the tunnel IO threads on a
+1-vCPU host? Compare put loops with: nothing / sleep(5ms) / GIL-holding
+Python spin / GIL-releasing numpy copy between puts.
+
+Verdict from the 2026-07-30 runs: no stable correlation — the rate
+swings are dominated by the tunnel's token-bucket state, not by what
+the host does between puts (see diag_link.py and bench.LinkProbe)."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+
+
+def spin(secs):
+    t0 = time.perf_counter()
+    x = 0
+    while time.perf_counter() - t0 < secs:
+        x += 1
+    return x
+
+
+def numpy_work(arr):
+    # large memcpy-ish op; numpy releases the GIL for big copies
+    return arr.copy()
+
+
+def put_loop(bufs, n, between=None):
+    import jax
+
+    t0 = time.perf_counter()
+    put_secs = 0.0
+    for i in range(n):
+        tp = time.perf_counter()
+        d = jax.device_put(bufs[i % len(bufs)])
+        jax.block_until_ready(d)
+        put_secs += time.perf_counter() - tp
+        if between is not None:
+            between()
+    dt = time.perf_counter() - t0
+    return {
+        "total_secs": round(dt, 3),
+        "put_secs": round(put_secs, 3),
+        "put_mb_per_sec": round(bufs[0].nbytes * n / put_secs / 1e6, 1),
+    }
+
+
+def main():
+    import jax
+
+    jax.local_devices()
+    rng = np.random.default_rng(5)
+    NB = 8060928
+    bufs = [rng.integers(0, 255, NB, dtype=np.uint8) for _ in range(8)]
+    big = rng.normal(size=1 << 20)  # ~8MB f64 for numpy work
+    N = 20
+    out = {}
+    for r in range(2):
+        out[f"none_{r}"] = put_loop(bufs, N)
+        out[f"sleep5ms_{r}"] = put_loop(
+            bufs, N, lambda: time.sleep(0.005)
+        )
+        out[f"pyspin5ms_{r}"] = put_loop(bufs, N, lambda: spin(0.005))
+        out[f"numpy_copy_{r}"] = put_loop(
+            bufs, N, lambda: numpy_work(big)
+        )
+        out[f"pyspin20ms_{r}"] = put_loop(bufs, N, lambda: spin(0.020))
+    print(json.dumps(out, indent=1))
+
+
+if __name__ == "__main__":
+    main()

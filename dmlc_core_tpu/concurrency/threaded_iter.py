@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time as _time
 from typing import Callable, Generic, Iterable, Iterator, Optional, TypeVar
 
 T = TypeVar("T")
@@ -88,16 +89,27 @@ class ThreadedIter(Generic[T]):
         except BaseException as e:  # noqa: BLE001 — crosses thread boundary
             self._put(q, kill, (_EXC, e))
 
-    def _stop(self) -> Optional[BaseException]:
+    def _stop(
+        self, timeout: Optional[float] = None
+    ) -> Optional[BaseException]:
         """Tear down the producer; returns any pending producer exception
         found while draining (must not be silently lost — reference
-        rethrows in BeforeFirst, threadediter.h:406-435)."""
+        rethrows in BeforeFirst, threadediter.h:406-435).
+
+        With ``timeout``, a producer thread that stays alive past the
+        deadline — blocked in user code (slow upstream IO) that Python
+        cannot interrupt — is orphaned instead of joined: the kill flag
+        is set, so the daemon thread exits at its next queue put, and
+        the caller's teardown doesn't wedge for the stall's duration."""
         t = self._thread
         if t is None:
             return None
         pending: Optional[BaseException] = None
         self._kill.set()
+        deadline = None if timeout is None else _time.monotonic() + timeout
         while t.is_alive():
+            if deadline is not None and _time.monotonic() > deadline:
+                break
             try:  # drain so a blocked put() notices the kill flag
                 tag, val = self._queue.get_nowait()
                 if tag == _EXC:
@@ -146,12 +158,31 @@ class ThreadedIter(Generic[T]):
             raise pending
         self._start()
 
-    def destroy(self) -> None:
+    def destroy(self, timeout: Optional[float] = None) -> None:
         """Tear down the producer thread (reference ~ThreadedIter).
         Pending exceptions are intentionally dropped here — destruction
-        must not raise."""
+        must not raise.
+
+        The default joins the producer to completion — callers that
+        reuse a shared resource afterwards (CachedInputSplit's
+        before_first reopening the cache file, ShardedFusedBatches
+        closing mmaps) depend on that exclusivity. Pass a ``timeout``
+        only when an indefinite wedge behind a producer stalled in
+        uninterruptible IO is worse than orphaning the daemon thread
+        (it exits at its next queue put; StagingPipeline.close does
+        this, accepting that the caller must not tear down the
+        producer's underlying resources while a stall is suspected)."""
         self._destroyed = True
-        self._stop()
+        self._stop(timeout=timeout)
+        # wake any consumer blocked in next()'s queue.get() — without
+        # this, a downstream stage's thread blocked on THIS iterator
+        # (StagingPipeline's transfer thread pulling the parse queue)
+        # would never observe the teardown and its own destroy() would
+        # spin on join forever
+        try:
+            self._queue.put_nowait((_END, None))
+        except queue.Full:
+            pass  # consumer has items to drain; it isn't blocked
 
     def __del__(self) -> None:
         try:

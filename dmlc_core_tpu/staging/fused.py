@@ -228,7 +228,7 @@ class _FusedTextBatches:
         spec: BatchSpec,
         part_index: int = 0,
         num_parts: int = 1,
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(spec.value_dtype in (np.dtype(np.float32), np.dtype(np.float16)),
               f"fused path supports f32/f16 values, not {spec.value_dtype}")
@@ -332,7 +332,7 @@ class _FusedDenseTextBatches(_FusedTextBatches):
     """Dense-slot specialization: ring slots are (x, labels, weights,
     packed) views over one contiguous buffer per slot."""
 
-    def __init__(self, uri, spec, part_index=0, num_parts=1, ring=8):
+    def __init__(self, uri, spec, part_index=0, num_parts=1, ring=10):
         check(spec.layout == "dense", "fused path requires layout='dense'")
         super().__init__(uri, spec, part_index, num_parts, ring)
 
@@ -376,7 +376,7 @@ class FusedDenseLibSVMBatches(_FusedDenseTextBatches):
         part_index: int = 0,
         num_parts: int = 1,
         indexing_mode: int = 0,
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(native.HAS_DENSE, "native fused kernel not loaded")
         super().__init__(uri, spec, part_index, num_parts, ring)
@@ -428,7 +428,7 @@ class FusedDenseCSVBatches(_FusedDenseTextBatches):
         label_column: int = -1,
         weight_column: int = -1,
         delimiter: str = ",",
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(native.HAS_CSV_DENSE, "native fused csv kernel not loaded")
         super().__init__(uri, spec, part_index, num_parts, ring)
@@ -528,7 +528,7 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         spec: BatchSpec,
         part_index: int = 0,
         num_parts: int = 1,
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(native.HAS_ELL, "native fused ELL kernel not loaded")
         check(spec.layout == "ell", "fused rowrec path requires layout='ell'")
@@ -868,7 +868,7 @@ class FusedEllLibFMBatches(_EllSlotMixin, _FusedTextBatches):
         part_index: int = 0,
         num_parts: int = 1,
         indexing_mode: int = 0,
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(native.HAS_LIBFM_ELL, "native fused libfm kernel not loaded")
         check(spec.layout == "ell", "fused libfm path requires layout='ell'")
@@ -927,7 +927,7 @@ class FusedEllLibSVMBatches(_EllSlotMixin, _FusedTextBatches):
         part_index: int = 0,
         num_parts: int = 1,
         indexing_mode: int = 0,
-        ring: int = 8,
+        ring: int = 10,
     ) -> None:
         check(native.HAS_LIBSVM_ELL,
               "native fused libsvm ELL kernel not loaded")
@@ -973,7 +973,7 @@ def ell_batches(
     spec: BatchSpec,
     part_index: int = 0,
     num_parts: int = 1,
-    ring: int = 8,
+    ring: int = 10,
     nthread: Optional[int] = None,
     format: str = "auto",
     indexing_mode: int = 0,
@@ -1105,7 +1105,7 @@ def dense_batches(
     num_parts: int = 1,
     nthread: Optional[int] = None,
     indexing_mode: int = 0,
-    ring: int = 8,
+    ring: int = 10,
     format: str = "auto",
 ):
     """Best-available dense Batch stream for a libsvm or csv URI.
