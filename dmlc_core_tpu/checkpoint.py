@@ -194,6 +194,7 @@ def save_pytree_sharded(
     tree: Any,
     process_index: Optional[int] = None,
     process_count: Optional[int] = None,
+    meta: Optional[Dict[str, Any]] = None,
 ) -> None:
     """Write a (possibly non-addressable, mesh-sharded) pytree checkpoint.
 
@@ -229,7 +230,7 @@ def save_pytree_sharded(
     skeleton, chunks = _snapshot_sharded(tree)
     _write_sharded(
         dir_uri, skeleton, chunks, process_index, process_count,
-        barrier_tag="", coordination_only=False,
+        barrier_tag="", coordination_only=False, meta=meta,
     )
 
 
@@ -300,6 +301,7 @@ def _write_sharded(
     process_count: int,
     barrier_tag: str = "",
     coordination_only: bool = False,
+    meta: Optional[Dict[str, Any]] = None,
 ) -> None:
     """The I/O + completeness protocol of a sharded save (collective).
 
@@ -320,10 +322,15 @@ def _write_sharded(
     _write_atomic(shard_uri, {"proc": process_index, "chunks": chunks})
     _sync_processes(f"dmlc_ckpt_shards:{base}:{barrier_tag}", coordination_only)
     if process_index == 0:
-        _write_atomic(
-            f"{base}/{_MANIFEST}",
-            {"tree": skeleton, "nprocs": process_count},
-        )
+        manifest: Dict[str, Any] = {
+            "tree": skeleton, "nprocs": process_count,
+        }
+        if meta is not None:
+            # caller metadata (e.g. the data position: epoch + records
+            # consumed, §5.4 mid-epoch resume) rides the manifest — same
+            # completeness guarantee as the tree itself
+            manifest["meta"] = meta
+        _write_atomic(f"{base}/{_MANIFEST}", manifest)
     _sync_processes(
         f"dmlc_ckpt_manifest:{base}:{barrier_tag}", coordination_only
     )
@@ -582,6 +589,13 @@ class Checkpointer:
         ext = ".d" if sharded else ".bin"
         return f"{self.base}/ckpt-{step:010d}{ext}"
 
+    def _meta_path(self, step: int) -> str:
+        # sidecar for the legacy .bin layout; written BEFORE the main
+        # rename so a visible .bin implies its metadata landed.
+        # (The name doesn't match _PAT — sidecars are invisible to the
+        # step scan.) Sharded .d checkpoints carry meta in the manifest.
+        return f"{self.base}/ckpt-{step:010d}.meta.bin"
+
     def _manifest_ok(self, dir_uri: str) -> bool:
         """A .d checkpoint is complete iff its manifest landed (written
         after the all-shards barrier)."""
@@ -708,7 +722,9 @@ class Checkpointer:
             if handle.done():
                 self._inflight = None
 
-    def save_async(self, step: int, tree: Any) -> AsyncSave:
+    def save_async(
+        self, step: int, tree: Any, meta: Optional[Dict[str, Any]] = None
+    ) -> AsyncSave:
         """Checkpoint with the file I/O overlapped against training.
 
         The device→host snapshot happens HERE, synchronously — after
@@ -772,6 +788,7 @@ class Checkpointer:
                     path, skeleton, chunks, proc, count,
                     barrier_tag=tag,
                     coordination_only=count > 1,
+                    meta=meta,
                 )
                 if proc == 0:
                     _remove_uri(self._path(step))
@@ -790,7 +807,9 @@ class Checkpointer:
                     # same contract as sync save(): None on non-writers —
                     # the URI is only meaningful where the file exists
                     return None
-                return self._write_single(step, host_tree, tag="async ")
+                return self._write_single(
+                    step, host_tree, tag="async ", meta=meta
+                )
 
         def run():
             try:
@@ -806,10 +825,18 @@ class Checkpointer:
         self._inflight = handle
         return handle
 
-    def save(self, step: int, tree: Any) -> Optional[str]:
+    def save(
+        self, step: int, tree: Any, meta: Optional[Dict[str, Any]] = None
+    ) -> Optional[str]:
         """Returns the checkpoint URI (None on non-writer processes in
         the legacy single-file layout; the sharded layout is collective —
-        every process writes its shard and gets the URI back)."""
+        every process writes its shard and gets the URI back).
+
+        ``meta``: small host-side dict stored WITH the checkpoint under
+        the same completeness guarantee (manifest for .d, pre-rename
+        sidecar for .bin) and read back via ``restore_meta`` — the §5.4
+        data-position slot: ``{"epoch": e, "records": n}`` lets a resume
+        fast-forward the input pipeline to where the save happened."""
         self.wait()  # an overlapping async write to the same base
         if self._needs_sharded(tree):
             path = self._path(step, sharded=True)
@@ -818,6 +845,7 @@ class Checkpointer:
                 tree,
                 process_index=self._proc,
                 process_count=self._count,
+                meta=meta,
             )
             if self._is_writer():
                 # a same-step legacy .bin would now be stale data
@@ -827,9 +855,15 @@ class Checkpointer:
             return path
         if not self._is_writer():
             return None
-        return self._write_single(step, tree)
+        return self._write_single(step, tree, meta=meta)
 
-    def _write_single(self, step: int, tree: Any, tag: str = "") -> str:
+    def _write_single(
+        self,
+        step: int,
+        tree: Any,
+        tag: str = "",
+        meta: Optional[Dict[str, Any]] = None,
+    ) -> str:
         """Single-file (.bin) write + same-step shadow invalidation +
         retention — shared by sync save() and the async worker so the
         tear ordering can never diverge between them.
@@ -844,12 +878,34 @@ class Checkpointer:
         if had_shadow:
             _clear_manifest(sharded_path)
         path = self._path(step)
+        # sidecar ordering: clear any stale sidecar, land the tree, THEN
+        # write the new sidecar — no crash window can pair one save's
+        # meta with another save's tree (a meta claiming a position the
+        # visible params never reached would make a resume silently skip
+        # data). The benign residual window is a visible .bin whose
+        # sidecar didn't land: restore_meta returns None and the caller
+        # falls back to position-unknown (replay, never skip).
+        _remove_uri(self._meta_path(step))
         _write_atomic(path, tree)
+        if meta is not None:
+            _write_atomic(self._meta_path(step), meta)
         if had_shadow:
             _remove_uri(sharded_path, tree_ok=True)
         self._prune()
         log_info(f"{tag}checkpoint step {step} -> {path}")
         return path
+
+    def _resolve(self, step: Optional[int]) -> Tuple[int, bool]:
+        """(step, sharded?) for the given or newest step — the shared
+        wait/scan preamble of restore and restore_meta (one base listing
+        per call; remote LISTs are not free)."""
+        self.wait()  # never read past an in-flight write
+        scan = self._scan()
+        if step is None:
+            check(bool(scan), f"no checkpoints under {self.base}")
+            step = max(scan)
+        step = int(step)
+        return step, scan.get(step, False)
 
     def restore(
         self, step: Optional[int] = None, template: Any = None
@@ -859,13 +915,8 @@ class Checkpointer:
         ``template``: optional pytree of jax arrays / ShapeDtypeStructs
         whose shardings say where each restored leaf should live on the
         CURRENT mesh (resharding restore). Applies to both layouts."""
-        self.wait()  # never read past an in-flight write
-        scan = self._scan()
-        if step is None:
-            check(bool(scan), f"no checkpoints under {self.base}")
-            step = max(scan)
-        step = int(step)
-        if scan.get(step, False):
+        step, sharded = self._resolve(step)
+        if sharded:
             return step, load_pytree_sharded(
                 self._path(step, sharded=True), template
             )
@@ -880,6 +931,26 @@ class Checkpointer:
             )
         return step, tree
 
+    def restore_meta(
+        self, step: Optional[int] = None
+    ) -> Optional[Dict[str, Any]]:
+        """The ``meta`` dict stored with the given (or newest) step, or
+        None when that save carried none (treat None as position
+        unknown: replay conservatively, never skip)."""
+        step, sharded = self._resolve(step)
+        if sharded:
+            manifest = load_pytree(
+                f"{self._path(step, sharded=True)}/{_MANIFEST}"
+            )
+            return manifest.get("meta")
+        meta_uri = self._meta_path(step)
+        try:
+            if not FileSystem.get_instance(meta_uri).exists(meta_uri):
+                return None
+        except (OSError, Error):
+            return None
+        return load_pytree(meta_uri)
+
     def _prune(self) -> None:
         steps = self.steps()
         if steps:
@@ -888,6 +959,7 @@ class Checkpointer:
             return
         for s in steps[: -self.keep]:
             _remove_uri(self._path(s))
+            _remove_uri(self._meta_path(s))
             _remove_uri(self._path(s, sharded=True), tree_ok=True)
 
     def _prune_torn(self, newest_complete: int) -> None:

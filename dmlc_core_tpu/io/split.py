@@ -500,8 +500,19 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         batch_size: int = 256,
         shuffle=False,
         seed: int = 0,
+        epoch: int = 0,
+        skip_records: int = 0,
         filesys: Optional[FileSystem] = None,
     ) -> None:
+        """``epoch``/``skip_records``: data-position fast-forward (§5.4
+        mid-epoch resume). The permutation is derived from (seed, epoch)
+        alone — a DOCUMENTED divergence from the reference's persistent
+        RNG (indexed_recordio_split.cc:221-233 reshuffles with carried
+        state), which makes any epoch's read order reproducible without
+        replaying the epochs before it. ``skip_records`` skips that many
+        records of the starting epoch arithmetically (no I/O); in
+        ``shuffle='batch'`` mode it must land on a span boundary — the
+        positions a batch-granular consumer naturally checkpoints at."""
         if shuffle in (False, None, 0):
             self.shuffle_mode: Optional[str] = None
         elif shuffle in ("batch", 2):
@@ -510,7 +521,10 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self.shuffle_mode = "record"
         self.shuffle = self.shuffle_mode is not None
         self.batch_size = batch_size
-        self._rnd = random.Random(self.KRAND_MAGIC + seed)
+        self._seed = seed
+        self.epoch = epoch - 1  # before_first() increments into `epoch`
+        self._skip_next = skip_records
+        self.records_consumed = 0
         self._index: List[Tuple[int, int]] = []  # (offset, size)
         self._index_uri = index_uri
         self.index_begin = 0
@@ -560,26 +574,76 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self.before_first()
 
     def before_first(self) -> None:
-        """Reshuffles the permutation each epoch with persistent RNG state
-        (reference indexed_recordio_split.cc:221-233)."""
+        """Starts the next epoch: derives the permutation from
+        (seed, epoch) — deterministic per epoch, so a resume can rebuild
+        epoch N's exact read order directly (reference
+        indexed_recordio_split.cc:221-233 reshuffles with persistent RNG
+        state instead; divergence documented on __init__)."""
         if self.index_end <= self.index_begin:
             return
+        self.epoch += 1
+        rnd = random.Random(
+            self.KRAND_MAGIC + self._seed + 1_000_003 * self.epoch
+        )
         if self.shuffle_mode == "batch":
             # permute span STARTS; each span is batch_size contiguous
-            # records read in one seek
-            self._permutation = list(
-                range(self.index_begin, self.index_end, self.batch_size)
+            # records read in one seek. Only FULL spans are shuffled —
+            # the remainder span (ntotal % batch_size records) always
+            # reads last, so every multiple of batch_size is a span
+            # boundary and therefore a resumable position (skip_records
+            # would otherwise land inside the short span whenever the
+            # shuffle placed it early)
+            total = self.index_end - self.index_begin
+            full_end = self.index_begin + (total // self.batch_size) * (
+                self.batch_size
             )
-            self._rnd.shuffle(self._permutation)
+            self._permutation = list(
+                range(self.index_begin, full_end, self.batch_size)
+            )
+            rnd.shuffle(self._permutation)
+            if full_end < self.index_end:
+                self._permutation.append(full_end)
             self._current = 0
         elif self.shuffle_mode == "record":
             self._permutation = list(range(self.index_begin, self.index_end))
-            self._rnd.shuffle(self._permutation)
+            rnd.shuffle(self._permutation)
             self._current = 0
         else:
             self._current = self.index_begin
         self._n_overflow = 0
+        self.records_consumed = 0
+        if self._skip_next:
+            self._fast_forward(self._skip_next)
+            self._skip_next = 0
         super().before_first()
+
+    def _fast_forward(self, n: int) -> None:
+        """Skip ``n`` records of the CURRENT epoch arithmetically."""
+        total = self.index_end - self.index_begin
+        check(
+            0 <= n <= total,
+            f"skip_records={n} outside this shard's {total} records",
+        )
+        if self.shuffle_mode == "batch":
+            # walk permuted spans, accumulating their true lengths (the
+            # span containing index_end is short)
+            done = 0
+            while done < n and self._current < len(self._permutation):
+                s = self._permutation[self._current]
+                span = min(s + self.batch_size, self.index_end) - s
+                check(
+                    done + span <= n,
+                    f"skip_records={n} lands inside a shuffled span of "
+                    f"{span} (checkpoint at span boundaries — batch_size="
+                    f"{self.batch_size} multiples)",
+                )
+                done += span
+                self._current += 1
+        elif self.shuffle_mode == "record":
+            self._current = n
+        else:
+            self._current = self.index_begin + n
+        self.records_consumed = n
 
     def _read_at(self, offset: int, size: int) -> bytes:
         """Seek to an absolute dataset offset and read (the shuffle path's
@@ -624,6 +688,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 else self.file_offset[-1]
             )
             chunk = self._read_at(begin_off, end_off - begin_off)
+            if chunk:
+                self.records_consumed += e - s
             return chunk if chunk else None
         if self.shuffle:
             n = self._n_overflow or n_records
@@ -635,6 +701,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             if not parts:
                 return None
             self._n_overflow = n - len(parts)
+            self.records_consumed += len(parts)
             return b"".join(parts)
         n = self._n_overflow or n_records
         last = min(self._current + n, self.index_end)
@@ -646,6 +713,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._index[last][0] if last < len(self._index) else self.file_offset[-1]
         )
         chunk = self._read_at(begin_off, end_off - begin_off)
+        if chunk:
+            self.records_consumed += last - self._current
         self._current = last
         return chunk if chunk else None
 
@@ -972,6 +1041,8 @@ def create(
     recurse_directories: bool = False,
     num_shuffle_parts: int = 0,
     threaded: bool = True,
+    epoch: int = 0,
+    skip_records: int = 0,
 ) -> InputSplit:
     """InputSplit factory (reference InputSplit::Create, src/io.cc:81-130).
 
@@ -1015,6 +1086,12 @@ def create(
         shuffle = norm_shuffle(shuffle)
         if batch_size is None:
             batch_size = uri_int(spec.args, "batch_size", 256)
+        # data-position resume sugar (?epoch=E&skip_records=N): start at
+        # epoch E's deterministic permutation, N records in (§5.4)
+        if epoch == 0:
+            epoch = uri_int(spec.args, "epoch", 0)
+        if skip_records == 0:
+            skip_records = uri_int(spec.args, "skip_records", 0)
         check(
             not (shuffle and spec.cache_file),
             "indexed shuffle with a #cachefile would freeze the first "
@@ -1022,6 +1099,17 @@ def create(
         )
     else:
         shuffle = norm_shuffle(shuffle)
+        # position fast-forward needs count-indexed access; silently
+        # starting at record 0 would make a resume retrain duplicate
+        # data — refuse loudly (the check() idiom of the sugar below)
+        check(
+            epoch == 0
+            and skip_records == 0
+            and "epoch" not in spec.args
+            and "skip_records" not in spec.args,
+            f"epoch/skip_records require an indexed recordio source "
+            f"(?index=<uri>), not type={type!r}",
+        )
     batch_size = 256 if batch_size is None else batch_size
     if type == "text" and spec.uri == "-":
         return SingleFileSplit("-")
@@ -1043,6 +1131,8 @@ def create(
             batch_size=batch_size,
             shuffle=shuffle,
             seed=seed,
+            epoch=epoch,
+            skip_records=skip_records,
         )
     else:
         raise Error(f"unknown InputSplit type {type!r}")

@@ -85,35 +85,76 @@ def main() -> None:
     model = FactorizationMachine(N_FEATURES, embed_dim=8)
     params = model.init(jax.random.PRNGKey(0))
     step = jax.jit(lambda p, b: model.sgd_step(p, b, lr=0.1))
-    ck = Checkpointer("/tmp/criteo_ckpts", keep=2, process_index=rank)
+    # v2: steps are global BATCH counts with (epoch, records) metadata —
+    # a fresh directory, so checkpoints from the older epoch-numbered
+    # layout can't be misread as positions
+    ck = Checkpointer("/tmp/criteo_ckpts_v2", keep=2, process_index=rank)
 
+    # resume: params + the DATA POSITION (epoch, records consumed) the
+    # save recorded — a mid-epoch preemption fast-forwards into the same
+    # shuffled epoch instead of replaying or skipping rows (§5.4)
     start = ck.latest_step()
+    gstep, start_epoch, skip = 0, 0, 0
     if start is not None:
-        start, params = ck.restore(start)
-        print(f"rank {rank}: resumed from checkpoint step {start}")
-    first_epoch = 0 if start is None else start + 1
+        gstep, params = ck.restore(start)
+        pos = ck.restore_meta(start)
+        if pos is not None:
+            start_epoch, skip = int(pos["epoch"]), int(pos["records"])
+            print(
+                f"rank {rank}: resumed step {gstep} at epoch "
+                f"{start_epoch}, {skip} records in"
+            )
+        else:
+            # no position recorded (crash before the sidecar landed):
+            # conservative fallback — keep the params, replay from
+            # epoch 0 rather than risk skipping data
+            print(
+                f"rank {rank}: resumed step {gstep}; no data position "
+                f"recorded, replaying from epoch 0"
+            )
 
-    spec = BatchSpec(batch_size=2048, layout="ell", max_nnz=K)
+    B = 2048
+    SAVE_EVERY = 4  # batches between mid-epoch position checkpoints
+    spec = BatchSpec(batch_size=B, layout="ell", max_nnz=K)
     # with a sidecar index, shards are count-exact and each epoch reads
     # in a fresh shuffled order (URI sugar → IndexedRecordIOSplitter);
     # without one, fall back to sequential byte-sharded reads
     has_index = os.path.exists(path + ".idx")
-    for epoch in range(first_epoch, first_epoch + 3):
+    for epoch in range(start_epoch, 3):
         # shuffle=batch: permuted SPANS of batch_size records, one
         # coalesced seek per span — sequential-read throughput at
         # shuffle granularity batch_size (shuffle=1 would be the
-        # reference's per-record-seek full permutation)
+        # reference's per-record-seek full permutation). The permutation
+        # derives from (seed, epoch), so `epoch=`/`skip_records=` land a
+        # resume on the exact record the crash interrupted.
         uri = (
-            f"{path}?index={path}.idx&shuffle=batch&batch_size=2048"
-            f"&seed={epoch + 1}"
+            f"{path}?index={path}.idx&shuffle=batch&batch_size={B}"
+            f"&seed=1&epoch={epoch}"
+            + (f"&skip_records={skip}" if skip else "")
             if has_index
             else path
         )
         stream = ell_batches(uri, spec, part_index=rank, num_parts=world)
         pipe = StagingPipeline(stream)
         loss = None
+        consumed, skip = skip, 0
         for batch in pipe:
             params, loss = step(params, batch)
+            consumed += int((np.asarray(batch["weights"]) > 0).sum())
+            gstep += 1
+            # mid-epoch position checkpoint: only at span-aligned
+            # positions (a padded tail batch is not resumable-into; the
+            # epoch-end save right below covers it). Rank 0 writes; with
+            # count-exact index shards every rank is at the same
+            # full-batch position, so rank 0's count speaks for all.
+            if (
+                has_index and gstep % SAVE_EVERY == 0
+                and consumed % B == 0
+            ):
+                ck.save_async(
+                    gstep, params,
+                    meta={"epoch": epoch, "records": consumed},
+                )
         stats = pipe.throughput()
         loss_str = "n/a (empty shard)" if loss is None else f"{float(loss):.4f}"
         print(
@@ -123,10 +164,13 @@ def main() -> None:
         )
         stream.close()
         pipe.close()
+        # epoch boundary: next resume starts the following epoch clean.
         # async: the write overlaps the next epoch's training; ck.save/
         # restore/wait all drain it, and the final wait() below surfaces
         # any background write failure before we declare success
-        ck.save_async(epoch, params)
+        ck.save_async(
+            gstep, params, meta={"epoch": epoch + 1, "records": 0}
+        )
     ck.wait()
     print("latest checkpoint step:", ck.latest_step())
     if worker is not None:
