@@ -1,0 +1,137 @@
+"""tpu-pod backend integration (VERDICT r4 #7): a fake ``gcloud`` that
+executes the ``--command`` payload locally, driven through the REAL
+submit → tracker rendezvous → Supervisor pipeline, at the same depth as
+``test_local_submit_end_to_end``:
+
+- 2-worker submit: both contracts exported (DMLC_* + JAX_* coordinator
+  env), ranks rendezvous through the real tracker;
+- one injected worker death on its first attempt: the Supervisor
+  relaunches with the same task id (pinned placement) and the job
+  completes;
+- a worker that always dies: the failure budget trips and the pinned
+  placement (allow_replacement=False) aborts the job instead of
+  wedging the rendezvous wait.
+"""
+
+import importlib
+import os
+import stat
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAKE_GCLOUD = """#!/bin/sh
+# gcloud stand-in: find the --command payload and run it locally.
+# Everything else (compute tpus tpu-vm ssh <name> --worker N ...) is
+# accepted and ignored, matching the real CLI's shape.
+prev=""
+cmd=""
+for a in "$@"; do
+  if [ "$prev" = "--command" ]; then cmd="$a"; fi
+  prev="$a"
+done
+if [ -z "$cmd" ]; then echo "fake gcloud: no --command" >&2; exit 2; fi
+exec sh -c "$cmd"
+"""
+
+WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+# record every attempt before doing anything that can fail
+with open({out!r} + "_attempts", "a") as f:
+    f.write("%s:%s\\n" % (os.environ["DMLC_TASK_ID"],
+                          os.environ["DMLC_NUM_ATTEMPT"]))
+mode = {mode!r}
+tid = int(os.environ["DMLC_TASK_ID"])
+att = int(os.environ["DMLC_NUM_ATTEMPT"])
+if mode == "die_once" and tid == 1 and att == 0:
+    os._exit(1)  # killed before rendezvous; Supervisor must relaunch
+if mode == "die_always" and tid == 1:
+    os._exit(1)
+from dmlc_core_tpu.tracker.client import RabitWorker
+w = RabitWorker()
+rank = w.start()
+with open({out!r} + str(rank), "w") as f:
+    f.write("%s %s %s %s" % (
+        rank,
+        os.environ["DMLC_ROLE"],
+        os.environ["JAX_COORDINATOR_ADDRESS"],
+        os.environ["JAX_PROCESS_ID"],
+    ))
+w.shutdown()
+"""
+
+
+@pytest.fixture()
+def fake_gcloud(tmp_path, monkeypatch):
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    g = bindir / "gcloud"
+    g.write_text(FAKE_GCLOUD)
+    g.chmod(g.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return g
+
+
+def _submit(tmp_path, mode, out):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=REPO, out=out, mode=mode))
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main([
+        "--cluster", "tpu-pod", "--num-workers", "2",
+        "--tpu-name", "fake-pod", "--tpu-zone", "nowhere-1a",
+        "--host-ip", "127.0.0.1",
+        sys.executable, str(script),
+    ])
+
+
+@pytest.mark.slow
+def test_tpu_pod_submit_end_to_end(tmp_path, fake_gcloud, monkeypatch):
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "3")
+    out = str(tmp_path / "rank")
+    _submit(tmp_path, "ok", out)
+    for r in range(2):
+        rank, role, coord, pid = open(out + str(r)).read().split()
+        assert int(rank) == r and role == "worker"
+        # the jax.distributed contract rode the env exports
+        assert coord.endswith(":8476")
+        assert 0 <= int(pid) < 2
+    attempts = open(out + "_attempts").read().splitlines()
+    assert sorted(attempts) == ["0:0", "1:0"]
+
+
+@pytest.mark.slow
+def test_tpu_pod_relaunch_same_task_id_after_kill(
+    tmp_path, fake_gcloud, monkeypatch
+):
+    """Supervised relaunch keeps the task id (= pod host = InputSplit
+    part). The worker dies BEFORE rendezvous, so this covers the
+    Supervisor x tracker composition, not rank reclaim — that path is
+    drilled in test_tracker.py's pod-scale drill."""
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "3")
+    out = str(tmp_path / "rank")
+    _submit(tmp_path, "die_once", out)
+    got = {int(open(out + str(r)).read().split()[0]) for r in range(2)}
+    assert got == {0, 1}
+    attempts = sorted(open(out + "_attempts").read().splitlines())
+    # worker 1 died on attempt 0 and came back as attempt 1, same task id
+    assert attempts == ["0:0", "1:0", "1:1"]
+
+
+@pytest.mark.slow
+def test_tpu_pod_pinned_placement_aborts_past_budget(
+    tmp_path, fake_gcloud, monkeypatch
+):
+    from dmlc_core_tpu.tracker.supervisor import JobAborted
+
+    monkeypatch.setenv("DMLC_MAX_ATTEMPT", "2")
+    out = str(tmp_path / "rank")
+    with pytest.raises(JobAborted):
+        _submit(tmp_path, "die_always", out)
+    attempts = sorted(open(out + "_attempts").read().splitlines())
+    # budget of 2 attempts for task 1, then abort — no replacement host
+    # (fixed placement: JAX process i must run on pod host i)
+    assert attempts.count("1:0") == 1 and attempts.count("1:1") == 1
+    assert not any(a.startswith("1:2") for a in attempts)

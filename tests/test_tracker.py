@@ -459,6 +459,176 @@ def test_pod_scale_rendezvous_64_workers():
     assert elapsed < 60, f"rendezvous took {elapsed:.1f}s"
 
 
+@pytest.mark.slow
+def test_pod_scale_drill_supervisor_with_failures():
+    """VERDICT r4 #8: the 64-worker rendezvous run UNDER the Supervisor
+    with 2 injected worker deaths (ungraceful close after the
+    rendezvous settles, no shutdown). The rabit recover contract plays
+    out in full: relaunched attempts reclaim their previous ranks,
+    NEIGHBOR survivors detect their dead link sockets and re-enter
+    rendezvous (start(recover_rank=own)) so the tracker can broker the
+    re-wiring, the job completes, and wall-clock stays bounded — the
+    broker pool, recover path, and Supervisor compose at pod scale."""
+    import select
+    import socket as socket_mod
+
+    n = 64
+    die_once = {7, 23}
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+
+    from dmlc_core_tpu.tracker.supervisor import Supervisor
+
+    lock = threading.Lock()
+    ranks_first = {}  # task_id -> rank obtained on the doomed attempt
+    ranks_final = [None] * n
+    healed = []  # task_ids that re-rendezvoused after a dead link
+    # phase gates: deaths happen after the FULL rendezvous settles (a
+    # close racing a peer's link-accept would just lose that link inside
+    # initial wiring); shutdowns happen only after nobody scans links
+    # anymore (a shutdown's closes would read as deaths otherwise)
+    started = {"count": 0}
+    all_started = threading.Event()
+    recovered = {"count": 0}
+    all_recovered = threading.Event()
+    deaths = {"count": 0}
+    deaths_done = threading.Event()
+    watchers = {"count": 0}
+    watchers_done = threading.Event()
+
+    class _ThreadTask:
+        """Popen-like handle over an in-process worker thread (the
+        Supervisor's documented contract: poll/kill/wait)."""
+
+        def __init__(self, fn):
+            self._ret = None
+
+            def body():
+                try:
+                    self._ret = fn()
+                except Exception:  # noqa: BLE001 — exit code, not raise
+                    import traceback
+
+                    # keep the trace visible: the Supervisor only sees
+                    # the exit code, and a silent assertion failure in a
+                    # 64-thread drill is undiagnosable otherwise
+                    traceback.print_exc()
+                    self._ret = 1
+
+            self._t = threading.Thread(target=body, daemon=True)
+            self._t.start()
+
+        def poll(self):
+            if self._t.is_alive():
+                return None
+            return self._ret if self._ret is not None else 1
+
+        def kill(self):
+            pass  # threads can't be killed; workers here always exit
+
+        def wait(self):
+            self._t.join()
+            return self.poll()
+
+    def dead_links(w):
+        """Ranks whose peer socket reached EOF (peer died)."""
+        by_sock = {s: r for r, s in w.links.items()}
+        try:
+            readable, _, _ = select.select(list(by_sock), [], [], 0)
+        except (OSError, ValueError):
+            return [r for r, s in w.links.items() if s.fileno() == -1]
+        out = []
+        for s in readable:
+            try:
+                if s.recv(1, socket_mod.MSG_PEEK) == b"":
+                    out.append(by_sock[s])
+            except OSError:
+                out.append(by_sock[s])
+        return out
+
+    def _mark(counter, event, target):
+        with lock:
+            counter["count"] += 1
+            if counter["count"] >= target:
+                event.set()
+
+    def work(task_id: int, attempt: int) -> int:
+        # jobid is stable across attempts — the tracker's recover path
+        # verifies the reclaimed rank belongs to the same job
+        w = RabitWorker("127.0.0.1", tracker.port, jobid=f"t{task_id}")
+        recover = -1
+        if attempt > 0:
+            with lock:
+                recover = ranks_first.get(task_id, -1)
+            assert recover >= 0, (
+                f"unexpected relaunch of non-doomed task {task_id}"
+            )
+        rank = w.start(
+            world_size=n if task_id == 0 else -1, recover_rank=recover
+        )
+        if attempt == 0:
+            _mark(started, all_started, n)
+        if attempt == 0 and task_id in die_once:
+            with lock:
+                ranks_first[task_id] = rank
+            assert all_started.wait(timeout=60)
+            w.close()  # dies WITHOUT shutdown: links drop, rank orphaned
+            _mark(deaths, deaths_done, len(die_once))
+            return 1
+        if attempt > 0:
+            _mark(recovered, all_recovered, len(die_once))
+        # the "training" phase: poll link health, self-heal on a dead
+        # peer by re-entering rendezvous with the SAME rank (the rabit
+        # recover contract this client documents in its link-wait error).
+        # Scans start only after BOTH deaths have happened: a survivor
+        # neighboring both dead ranks that scanned between the closes
+        # would heal toward one while still reporting the other as good,
+        # and the second recover session could then strand it mid-wait.
+        assert deaths_done.wait(timeout=60)
+        deadline = time.time() + 60
+        while not all_recovered.is_set() and time.time() < deadline:
+            dead = dead_links(w)
+            if dead:
+                for r in dead:
+                    s = w.links.pop(r, None)
+                    if s is not None:
+                        s.close()
+                got = w.start(recover_rank=rank)
+                assert got == rank, (got, rank)
+                with lock:
+                    healed.append(task_id)
+                continue
+            time.sleep(0.02)
+        assert all_recovered.is_set(), "relaunches never rejoined"
+        ranks_final[task_id] = rank
+        # nobody may shutdown while anyone still scans links: a closing
+        # survivor's sockets would read as new deaths
+        _mark(watchers, watchers_done, n)
+        assert watchers_done.wait(timeout=60)
+        w.shutdown()
+        return 0
+
+    sup = Supervisor(
+        lambda tid, host, att: _ThreadTask(lambda: work(tid, att)),
+        hosts=[f"pod-host-{i}" for i in range(n)],
+        max_attempt=3,
+        poll_interval=0.02,
+    )
+    t0 = time.time()
+    sup.run(n)
+    elapsed = time.time() - t0
+    tracker.join()  # every rank sent shutdown — job complete
+    tracker.close()
+    assert sup.relaunches == 2
+    assert sorted(ranks_final) == list(range(n))
+    for tid in die_once:
+        assert ranks_final[tid] == ranks_first[tid]  # same rank reclaimed
+    # the dead ranks had tree+ring neighbors; at least one survivor per
+    # death must have gone through the self-heal path
+    assert len(set(healed)) >= 2, healed
+    assert elapsed < 90, f"drill took {elapsed:.1f}s"
+
+
 def test_close_terminates_state_thread():
     """tracker.close() must stop the state thread even with the job
     incomplete (submit()'s abort path relies on it; the state thread
