@@ -10,6 +10,8 @@ from .stream import (  # noqa: F401
     MemoryStream,
     FileStream,
     Serializable,
+    StreamIO,
+    wrap_text,
 )
 from .filesystem import (  # noqa: F401
     FileSystem,

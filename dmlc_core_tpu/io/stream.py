@@ -17,7 +17,15 @@ from typing import Optional, Union
 
 from ..utils.logging import Error, check
 
-__all__ = ["Stream", "SeekStream", "MemoryStream", "FileStream", "Serializable"]
+__all__ = [
+    "Stream",
+    "SeekStream",
+    "MemoryStream",
+    "FileStream",
+    "Serializable",
+    "StreamIO",
+    "wrap_text",
+]
 
 
 class Stream:
@@ -144,3 +152,109 @@ class Serializable:
 
     def load(self, stream: Stream) -> None:
         raise NotImplementedError
+
+
+class StreamIO(_pyio.RawIOBase):
+    """``io.RawIOBase`` adapter over any Stream — the analogue of the
+    reference's ``dmlc::ostream``/``dmlc::istream`` std-stream adapters
+    (include/dmlc/io.h:318-443): third-party code wanting the standard
+    file protocol (``readinto``, ``io.BufferedReader`` buffering,
+    ``io.TextIOWrapper`` text/newline decoding, csv module, pickle,
+    np.load...) gets it over URI-dispatched backends (gs://, s3://,
+    mem://...).
+
+    ``mode``: 'r', 'w', or 'rw' — the direction(s) the underlying Stream
+    was opened for (the reference has separate istream/ostream; one
+    adapter class with a declared mode covers both). ``close_stream``:
+    whether closing the wrapper closes the underlying Stream (the
+    reference adapters keep the Stream caller-owned; default matches
+    that — pass True for a self-contained handle).
+    """
+
+    def __init__(
+        self,
+        stream: Stream,
+        mode: str = "r",
+        close_stream: bool = False,
+    ) -> None:
+        super().__init__()
+        check(mode in ("r", "w", "rw"), f"StreamIO mode {mode!r}")
+        self._stream = stream
+        self._mode = mode
+        self._close_stream = close_stream
+
+    # -- capabilities --------------------------------------------------------
+    def readable(self) -> bool:
+        return "r" in self._mode
+
+    def writable(self) -> bool:
+        return "w" in self._mode
+
+    def seekable(self) -> bool:
+        return isinstance(self._stream, SeekStream)
+
+    # -- RawIOBase primitives ------------------------------------------------
+    # failure modes follow the io protocol (io.UnsupportedOperation, an
+    # OSError), NOT the framework's Error — the adapter exists for
+    # third-party code that guards with `except OSError` stdlib-style
+
+    def readinto(self, b) -> int:
+        if "r" not in self._mode:
+            raise _pyio.UnsupportedOperation("not readable")
+        data = self._stream.read(len(b))
+        n = len(data)
+        b[:n] = data
+        return n
+
+    def write(self, b) -> int:
+        if "w" not in self._mode:
+            raise _pyio.UnsupportedOperation("not writable")
+        # every in-repo backend takes any buffer-protocol object; no copy
+        return self._stream.write(b)
+
+    def seek(self, pos: int, whence: int = _pyio.SEEK_SET) -> int:
+        if not isinstance(self._stream, SeekStream):
+            raise _pyio.UnsupportedOperation("stream is not seekable")
+        if whence == _pyio.SEEK_SET:
+            target = pos
+        elif whence == _pyio.SEEK_CUR:
+            target = self._stream.tell() + pos
+        else:
+            raise OSError("StreamIO supports SEEK_SET and SEEK_CUR only")
+        self._stream.seek(target)
+        return target
+
+    def tell(self) -> int:
+        if not isinstance(self._stream, SeekStream):
+            raise _pyio.UnsupportedOperation("stream is not seekable")
+        return self._stream.tell()
+
+    def flush(self) -> None:
+        if not self.closed:
+            self._stream.flush()
+
+    def close(self) -> None:
+        if not self.closed:
+            try:
+                super().close()  # flushes via flush()
+            finally:
+                if self._close_stream:
+                    self._stream.close()
+
+
+def wrap_text(
+    stream: Stream, mode: str = "r", **kwargs
+) -> _pyio.TextIOWrapper:
+    """Text-mode view of a Stream (``dmlc::ostream/istream`` use case):
+    ``wrap_text(Stream.create("gs://bucket/x.csv"))`` reads decoded
+    lines; ``wrap_text(s, "w")`` writes them. Keyword args pass through
+    to ``io.TextIOWrapper`` (encoding, newline, ...). Closing the
+    wrapper closes the Stream."""
+    raw = StreamIO(stream, mode=mode, close_stream=True)
+    if mode == "rw":
+        buf: _pyio.BufferedIOBase = _pyio.BufferedRandom(raw)
+    elif mode == "w":
+        buf = _pyio.BufferedWriter(raw)
+    else:
+        buf = _pyio.BufferedReader(raw)
+    return _pyio.TextIOWrapper(buf, **kwargs)

@@ -291,3 +291,80 @@ def test_recordio_reader_detects_corruption():
         RecordIOReader(MemoryStream(bytes(raw))).next_record()
     with pytest.raises(Error, match="truncated"):
         RecordIOReader(MemoryStream(s.getvalue()[:6])).next_record()
+
+
+# -- StreamIO / wrap_text: the dmlc::ostream/istream adapters ----------------
+
+def test_streamio_readinto_and_buffered_reader():
+    import io as pyio
+
+    from dmlc_core_tpu.io import StreamIO
+
+    s = MemoryStream(b"hello world, " * 100)
+    raw = StreamIO(s, mode="r")
+    assert raw.readable() and not raw.writable() and raw.seekable()
+    buf = bytearray(5)
+    assert raw.readinto(buf) == 5 and bytes(buf) == b"hello"
+    reader = pyio.BufferedReader(StreamIO(MemoryStream(b"abc\ndef\n")))
+    assert reader.readline() == b"abc\n"
+    assert reader.read() == b"def\n"
+
+
+def test_streamio_write_and_seek():
+    import io as pyio
+
+    from dmlc_core_tpu.io import StreamIO
+
+    s = MemoryStream()
+    raw = StreamIO(s, mode="w")
+    assert raw.writable() and not raw.readable()
+    with pyio.BufferedWriter(raw) as w:
+        w.write(b"0123456789")
+    assert s.getvalue() == b"0123456789"
+    # mode is enforced io-protocol-style (UnsupportedOperation is an
+    # OSError): a read-only wrapper must not write and vice versa, even
+    # though MemoryStream itself can do both
+    with pytest.raises(OSError):
+        StreamIO(MemoryStream(b"x"), mode="r").write(b"y")
+    with pytest.raises(OSError):
+        StreamIO(MemoryStream(b"x"), mode="w").readinto(bytearray(1))
+    rw = StreamIO(MemoryStream(b"0123456789"), mode="rw")
+    rw.seek(4)
+    assert rw.read(2) == b"45"
+    rw.seek(-2, 1)  # SEEK_CUR
+    assert rw.tell() == 4
+    with pytest.raises(OSError):
+        rw.seek(0, 2)  # SEEK_END unsupported
+
+
+def test_wrap_text_round_trip_and_csv_over_mem_uri():
+    import csv
+
+    from dmlc_core_tpu.io import MemoryFileSystem, wrap_text
+
+    MemoryFileSystem.reset()
+    try:
+        with wrap_text(Stream.create("mem://t/rows.csv", "w"), "w") as f:
+            csv.writer(f).writerows([["a", 1], ["b", 2]])
+        with wrap_text(Stream.create("mem://t/rows.csv", "r")) as f:
+            rows = list(csv.reader(f))
+        assert rows == [["a", "1"], ["b", "2"]]
+    finally:
+        MemoryFileSystem.reset()
+
+
+def test_streamio_close_stream_ownership():
+    from dmlc_core_tpu.io import StreamIO
+
+    class Tracked(MemoryStream):
+        closed_count = 0
+
+        def close(self):
+            Tracked.closed_count += 1
+            super().close()
+
+    s = Tracked(b"x")
+    StreamIO(s).close()  # caller-owned by default (reference semantics)
+    assert Tracked.closed_count == 0
+    StreamIO(s, close_stream=True).close()
+    assert Tracked.closed_count == 1
