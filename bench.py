@@ -39,9 +39,11 @@ import numpy as np
 N_ROWS = int(os.environ.get("BENCH_ROWS", "400000"))
 N_FEATURES = 28  # HIGGS
 EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
-# 32768 measured best on the tunneled v5e frontend: ~5% over 8192 fresh
-# and ~1.5x under sustained-transfer throttling (fewer, larger DMAs);
-# 65536 regressed. Sweep recorded 2026-07-30, PROGRESS round 3.
+# r5 re-sweep with the transfer-thread pipeline: 8192/16384/32768 all
+# reach ~2.5-2.8M rec rows/s on fresh burst credit and ~0.45-0.7M once
+# the token bucket drains — batch size is not the lever on this
+# frontend, the link state is (r3's "32768 best" predates the thread).
+# Keeping 32768: largest per-DMA batch without regressing either state.
 BATCH = int(os.environ.get("BENCH_BATCH", "32768"))
 # producer ring sized for the depth-3 pipeline below INCLUDING the
 # sharded fan-out case: ShardedFusedBatches advertises ring-(prefetch+1)
