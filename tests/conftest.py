@@ -37,3 +37,18 @@ try:
     jax.config.update("jax_platforms", "cpu")
 except ImportError:  # data-layer-only environments
     pass
+
+
+def install_fake_binary(tmp_path, monkeypatch, name, content):
+    """Drop an executable stand-in (fake gcloud/ssh/srun) onto PATH —
+    shared by the backend integration suites."""
+    import os
+    import stat
+
+    bindir = tmp_path / "bin"
+    bindir.mkdir(exist_ok=True)
+    f = bindir / name
+    f.write_text(content)
+    f.chmod(f.stat().st_mode | stat.S_IXUSR)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    return f

@@ -15,7 +15,6 @@ submit → tracker rendezvous → Supervisor pipeline, at the same depth as
 
 import importlib
 import os
-import stat
 import sys
 
 import pytest
@@ -66,13 +65,9 @@ w.shutdown()
 
 @pytest.fixture()
 def fake_gcloud(tmp_path, monkeypatch):
-    bindir = tmp_path / "bin"
-    bindir.mkdir()
-    g = bindir / "gcloud"
-    g.write_text(FAKE_GCLOUD)
-    g.chmod(g.stat().st_mode | stat.S_IXUSR)
-    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
-    return g
+    from conftest import install_fake_binary
+
+    return install_fake_binary(tmp_path, monkeypatch, "gcloud", FAKE_GCLOUD)
 
 
 def _submit(tmp_path, mode, out):
