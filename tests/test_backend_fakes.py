@@ -117,3 +117,75 @@ def test_slurm_submit_end_to_end(tmp_path, monkeypatch):
         sys.executable, str(script),
     ])
     _check_ranks(out, 2, "slurm")
+
+
+FAKE_QSUB = """#!/usr/bin/env python3
+# qsub stand-in: parse `-t 1-N`, run the array script N times locally
+# (detached, like a queued array job) with SGE_TASK_ID set.
+import subprocess, sys
+
+args = sys.argv[1:]
+lo, hi = 1, 1
+script = args[-1]
+i = 0
+while i < len(args) - 1:
+    if args[i] == "-t":
+        lo, hi = (int(x) for x in args[i + 1].split("-"))
+        i += 2
+    elif args[i] in ("-q", "-N", "-o", "-e", "-S"):
+        i += 2
+    else:
+        i += 1
+import os
+for tid in range(lo, hi + 1):
+    subprocess.Popen(["bash", script], env={"SGE_TASK_ID": str(tid),
+                                            "PATH": os.environ["PATH"]})
+sys.exit(0)  # real qsub returns once the job is queued
+"""
+
+FAKE_MESOS_EXECUTE = """#!/usr/bin/env python3
+# mesos-execute stand-in: apply --env= and run --command= locally,
+# blocking until the task exits (like the real CLI).
+import os, subprocess, sys
+
+env = dict(os.environ)
+cmd = None
+for a in sys.argv[1:]:
+    if a.startswith("--env="):
+        for kv in a[len("--env="):].split(";"):
+            k, v = kv.split("=", 1)
+            env[k] = v
+    elif a.startswith("--command="):
+        cmd = a[len("--command="):]
+sys.exit(subprocess.call(cmd, shell=True, env=env))
+"""
+
+
+@pytest.mark.slow
+def test_sge_submit_end_to_end(tmp_path, monkeypatch):
+    _install(tmp_path, monkeypatch, "qsub", FAKE_QSUB)
+    monkeypatch.chdir(tmp_path)  # the backend writes rundmlc.sh to cwd
+    out = str(tmp_path / "rank")
+    script = _worker_script(tmp_path, out)
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main([
+        "--cluster", "sge", "--num-workers", "2",
+        "--host-ip", "127.0.0.1",
+        sys.executable, str(script),
+    ])
+    _check_ranks(out, 2, "sge")
+
+
+@pytest.mark.slow
+def test_mesos_submit_end_to_end(tmp_path, monkeypatch):
+    _install(tmp_path, monkeypatch, "mesos-execute", FAKE_MESOS_EXECUTE)
+    monkeypatch.setenv("MESOS_MASTER", "fake-master:5050")
+    out = str(tmp_path / "rank")
+    script = _worker_script(tmp_path, out)
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main([
+        "--cluster", "mesos", "--num-workers", "2",
+        "--host-ip", "127.0.0.1",
+        sys.executable, str(script),
+    ])
+    _check_ranks(out, 2, "mesos")
