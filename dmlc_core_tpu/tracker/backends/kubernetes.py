@@ -88,6 +88,27 @@ def build_all_manifests(args, envs: Dict[str, object]) -> List[Dict]:
     return manifests
 
 
+def _apply_via_kubectl(manifests: List[Dict], namespace: str) -> None:
+    """Fallback submission path: ONE ``kubectl apply -f -`` of a v1 List
+    wrapping every Job (kubectl accepts JSON). Covers clusters where
+    only the CLI is installed — the python client is an optional
+    dependency, not a requirement — and keeps submission atomic-ish:
+    one process, one auth round trip, no half-submitted window between
+    per-manifest calls."""
+    import subprocess
+
+    bundle = {"apiVersion": "v1", "kind": "List", "items": manifests}
+    proc = subprocess.run(
+        ["kubectl", "apply", "-n", namespace, "-f", "-"],
+        input=json.dumps(bundle).encode(),
+    )
+    if proc.returncode != 0:
+        names = [m["metadata"]["name"] for m in manifests]
+        raise RuntimeError(
+            f"kubectl apply failed (rc={proc.returncode}) for {names}"
+        )
+
+
 def submit(args) -> None:
     def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
         manifests = build_all_manifests(args, envs)
@@ -97,10 +118,16 @@ def submit(args) -> None:
             return
         try:
             from kubernetes import client, config  # type: ignore
-        except ImportError as e:
-            raise RuntimeError(
-                "kubernetes backend requires the 'kubernetes' python client"
-            ) from e
+        except ImportError:
+            import shutil
+
+            if shutil.which("kubectl") is None:
+                raise RuntimeError(
+                    "kubernetes backend requires the 'kubernetes' python "
+                    "client or a kubectl binary on PATH"
+                ) from None
+            _apply_via_kubectl(manifests, args.kube_namespace)
+            return
         config.load_kube_config()
         batch = client.BatchV1Api()
         for m in manifests:

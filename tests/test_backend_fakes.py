@@ -189,3 +189,41 @@ def test_mesos_submit_end_to_end(tmp_path, monkeypatch):
         sys.executable, str(script),
     ])
     _check_ranks(out, 2, "mesos")
+
+
+FAKE_KUBECTL = """#!/usr/bin/env python3
+# kubectl stand-in: `kubectl apply -n NS -f -` reads a JSON v1 List of
+# Job manifests on stdin and runs each container command locally
+# (detached, like the cluster's job controller would).
+import json, os, subprocess, sys
+
+bundle = json.load(sys.stdin)
+for manifest in bundle["items"]:
+    spec = manifest["spec"]["template"]["spec"]["containers"][0]
+    env = dict(os.environ)
+    for kv in spec["env"]:
+        env[kv["name"]] = kv["value"]
+    subprocess.Popen(spec["command"], env=env)
+    print("job.batch/%s created" % manifest["metadata"]["name"])
+sys.exit(0)
+"""
+
+
+@pytest.mark.slow
+def test_kubernetes_submit_end_to_end(tmp_path, monkeypatch):
+    """Without the python kubernetes client installed, submission falls
+    back to `kubectl apply -f -` with the JSON manifests — driven here
+    end to end by a fake kubectl that runs the container command."""
+    _install(tmp_path, monkeypatch, "kubectl", FAKE_KUBECTL)
+    # pin the fallback deterministically: a host with the python client
+    # installed would otherwise submit to a REAL cluster here
+    monkeypatch.setitem(sys.modules, "kubernetes", None)
+    out = str(tmp_path / "rank")
+    script = _worker_script(tmp_path, out)
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main([
+        "--cluster", "kubernetes", "--num-workers", "2",
+        "--host-ip", "127.0.0.1",
+        sys.executable, str(script),
+    ])
+    _check_ranks(out, 2, "kubernetes")
