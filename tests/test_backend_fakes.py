@@ -227,3 +227,45 @@ def test_kubernetes_submit_end_to_end(tmp_path, monkeypatch):
         sys.executable, str(script),
     ])
     _check_ranks(out, 2, "kubernetes")
+
+
+FAKE_MPIRUN = """#!/usr/bin/env python3
+# mpirun stand-in (openmpi arg surface): `mpirun -n N -x K=V ... cmd`
+# spawns N local copies with the -x env applied, waits for all.
+import os, subprocess, sys
+
+args = sys.argv[1:]
+n = 1
+env = dict(os.environ)
+cmd = []
+i = 0
+while i < len(args):
+    a = args[i]
+    if a == "--version":
+        print("mpirun (Open MPI) 4.1-fake"); sys.exit(0)
+    if a == "-n":
+        n = int(args[i + 1]); i += 2
+    elif a == "-x":
+        k, v = args[i + 1].split("=", 1); env[k] = v; i += 2
+    elif a == "--hostfile":
+        i += 2
+    else:
+        cmd = args[i:]; break
+procs = [subprocess.Popen(cmd, env=env) for _ in range(n)]
+codes = [p.wait() for p in procs]
+sys.exit(next((c for c in codes if c), 0))
+"""
+
+
+@pytest.mark.slow
+def test_mpi_submit_end_to_end(tmp_path, monkeypatch):
+    _install(tmp_path, monkeypatch, "mpirun", FAKE_MPIRUN)
+    out = str(tmp_path / "rank")
+    script = _worker_script(tmp_path, out)
+    submit_mod = importlib.import_module("dmlc_core_tpu.tracker.submit")
+    submit_mod.main([
+        "--cluster", "mpi", "--num-workers", "2",
+        "--host-ip", "127.0.0.1",
+        sys.executable, str(script),
+    ])
+    _check_ranks(out, 2, "mpi")
