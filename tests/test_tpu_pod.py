@@ -70,6 +70,14 @@ def fake_gcloud(tmp_path, monkeypatch):
     return install_fake_binary(tmp_path, monkeypatch, "gcloud", FAKE_GCLOUD)
 
 
+@pytest.fixture(autouse=True)
+def _generous_grace(monkeypatch):
+    # anti-wedge grace: on the 1-vCPU CI host a loaded machine can
+    # stretch worker shutdown well past the 10s default, and a grace
+    # trip aborts the job as "not a rabit client" (observed flake)
+    monkeypatch.setenv("DMLC_RENDEZVOUS_GRACE", "60")
+
+
 def _submit(tmp_path, mode, out):
     script = tmp_path / "worker.py"
     script.write_text(WORKER.format(repo=REPO, out=out, mode=mode))
