@@ -131,3 +131,72 @@ def test_timer_thread_fires_periodically():
     assert n >= 3
     time.sleep(0.05)
     assert len(hits) == n  # stopped
+
+
+def test_threaded_iter_destroy_wakes_blocked_consumer():
+    """A consumer blocked in next() (empty queue, stalled producer) must
+    observe destroy() promptly — a downstream pipeline stage's thread
+    pulls this iterator and its own teardown would otherwise spin on
+    join forever (the StagingPipeline close path)."""
+    release = threading.Event()
+
+    def produce():
+        yield 1
+        release.wait(30)  # stalled upstream
+
+    it = ThreadedIter(produce, max_capacity=1)
+    assert it.next() == 1
+    got = {}
+
+    def consume():
+        got["item"] = it.next()  # blocks: queue empty, producer stalled
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()  # really blocked
+    it.destroy(timeout=1.0)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["item"] is None  # clean end-of-stream, not an exception
+    release.set()
+
+
+def test_threaded_iter_destroy_bounded_join_orphans_stalled_producer():
+    """destroy(timeout=...) must return within the bound even when the
+    producer thread is stuck in uninterruptible user code; the orphaned
+    daemon exits at its next queue put (kill flag)."""
+    release = threading.Event()
+
+    def produce():
+        yield 1
+        release.wait(30)  # emulates a blocking read Python can't interrupt
+        yield 2  # pragma: no cover — kill flag drops it at the put
+
+    it = ThreadedIter(produce, max_capacity=1)
+    assert it.next() == 1
+    time.sleep(0.1)  # let the producer enter the stall
+    t0 = time.monotonic()
+    it.destroy(timeout=0.5)
+    assert time.monotonic() - t0 < 5.0
+    release.set()  # orphan wakes, sees kill, exits without producing
+
+
+def test_threaded_iter_default_destroy_still_joins_fully():
+    """Without a timeout, destroy() keeps the join-to-completion
+    exclusivity restart sites rely on (CachedInputSplit.before_first
+    reopens shared resources right after)."""
+    done = []
+
+    def produce():
+        try:
+            yield 1
+            yield 2
+        finally:
+            time.sleep(0.3)  # slow cleanup in the producer
+            done.append(True)
+
+    it = ThreadedIter(produce, max_capacity=1)
+    assert it.next() == 1
+    it.destroy()  # no timeout: must wait for the producer's finally
+    assert done == [True]
