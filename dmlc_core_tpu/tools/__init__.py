@@ -240,7 +240,7 @@ def _cmd_ckpt(args) -> int:
     layout/size, inspect a step's tree shapes, prune to a retention
     count — over any URI backend (the reference leaves this to shell
     scripts against local disk). Uses only Checkpointer's public API
-    (steps_info/restore/prune)."""
+    (steps_info/restore/restore_meta/prune)."""
     import json
 
     from ..checkpoint import Checkpointer
@@ -270,7 +270,22 @@ def _cmd_ckpt(args) -> int:
                 return f"{t.dtype}{list(t.shape)}"
             return repr(t)
 
-        print(json.dumps({"step": step, "tree": describe(tree)}, indent=2))
+        out = {"step": step, "tree": describe(tree)}
+        # the §5.4 data position (epoch, records consumed) — an operator
+        # diagnosing a resume wants to see where the saved run was in
+        # its input stream. Degraded-but-working: a corrupt/unreadable
+        # sidecar must not cost the tree output the restore already
+        # produced. (Costs a second base scan — fine for a CLI inspect.)
+        try:
+            meta = ck.restore_meta(step)
+        except (DmlcError, OSError) as e:
+            meta = None
+            sys.stderr.write(f"warning: unreadable checkpoint meta: {e}\n")
+        if meta is not None:
+            out["meta"] = meta
+        # default=str: meta is a user dict and may hold non-JSON-native
+        # leaves (numpy scalars round-trip as 0-d arrays)
+        print(json.dumps(out, indent=2, default=str))
         return 0
     # prune: --keep passes through VERBATIM — keep <= 0 means retention
     # disabled (Checkpointer semantics), never a silent default

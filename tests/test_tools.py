@@ -269,7 +269,11 @@ def test_ckpt_ls_show_prune(tmp_path, capsys):
     base = str(tmp_path / "cks")
     ck = Checkpointer(base, keep=10, process_index=0)
     for s in (1, 2, 3):
-        ck.save(s, {"w": np.full((4, 2), s, np.float32), "step": s})
+        ck.save(
+            s,
+            {"w": np.full((4, 2), s, np.float32), "step": s},
+            meta={"epoch": s, "records": 64 * s} if s == 3 else None,
+        )
 
     rc, out, _ = run_cli(["ckpt", "ls", base], capsys)
     listing = json.loads(out)
@@ -280,9 +284,12 @@ def test_ckpt_ls_show_prune(tmp_path, capsys):
     shown = json.loads(out)
     assert rc == 0 and shown["step"] == 3
     assert shown["tree"]["w"] == "float32[4, 2]"
+    # the data position rides the inspection surface (§5.4)
+    assert shown["meta"] == {"epoch": 3, "records": 192}
 
     rc, out, _ = run_cli(["ckpt", "show", base, "--step", "1"], capsys)
-    assert json.loads(out)["step"] == 1
+    shown1 = json.loads(out)
+    assert shown1["step"] == 1 and "meta" not in shown1
 
     # --keep 0 disables pruning (Checkpointer semantics), never a
     # silent destructive default
