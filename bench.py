@@ -347,10 +347,21 @@ def ensure_rec_index() -> None:
     os.replace(tmp, REC_INDEX)
 
 
+# window-shuffle knobs for the rec_shuffled_window config: the window is
+# the client-side shuffle buffer (records), the merge gap the coalescer's
+# waste bound (bytes). A window of 2^18 records over the 400k-row shard
+# means ~2 windows/epoch, so the coalesced spans re-read each byte at
+# most ~2x — sequential I/O for a full per-record permutation.
+WINDOW = int(os.environ.get("BENCH_WINDOW", str(1 << 18)))
+MERGE_GAP = int(os.environ.get("BENCH_MERGE_GAP", str(64 << 10)))
+
+
 def _make_rec_shuffled_stream(mode: str):
     """Shuffled-epoch staging — the access pattern training actually
     uses. mode='1' = reference per-record seeks; mode='batch' = our
-    coalesced span shuffle (VERDICT r3 #5)."""
+    coalesced span shuffle (VERDICT r3 #5); mode='window' = full
+    per-record permutation served from coalesced spans + readahead
+    (ISSUE 1 tentpole)."""
     def make(value_dtype: str):
         from dmlc_core_tpu.staging import BatchSpec, ell_batches
 
@@ -363,6 +374,8 @@ def _make_rec_shuffled_stream(mode: str):
         uri = (
             f"{REC_DATA}?index={REC_INDEX}&shuffle={mode}&batch_size=4096"
         )
+        if mode == "window":
+            uri += f"&window={WINDOW}&merge_gap={MERGE_GAP}"
         return (
             ell_batches(uri, spec, nthread=_nthread_for(REC_ROWS), ring=_RING),
             "values",
@@ -473,10 +486,15 @@ def run_epoch(make_stream, value_dtype: str) -> dict:
     if last is not None:
         jax.block_until_ready(last[block_key])
     dt = time.perf_counter() - t0
+    # I/O-shape counters from the underlying split (shuffled indexed
+    # configs): spans ≪ records proves the coalescer is engaged, and
+    # seeks=0 proves the local pread fast path carried the spans
+    io_stats = getattr(stream, "io_stats", lambda: None)()
     if hasattr(stream, "close"):
         stream.close()
     pipe.close()
     return {
+        **({"io_stats": io_stats} if io_stats else {}),
         "rows": pipe.rows_staged,
         "secs": dt,
         "rows_per_sec": pipe.rows_staged / dt,
@@ -663,6 +681,8 @@ def main() -> None:
          lambda: run_epoch(_make_rec_shuffled_stream("1"), "float16")),
         ("rec_shuffled_batch",
          lambda: run_epoch(_make_rec_shuffled_stream("batch"), "float16")),
+        ("rec_shuffled_window",
+         lambda: run_epoch(_make_rec_shuffled_stream("window"), "float16")),
     ]
     # probe buffer ≈ the rec f16 packed batch (indices i32 + values f16
     # + label/weight f32, 8-byte aligned sections)
@@ -762,6 +782,27 @@ def main() -> None:
                 "recordio_shuffled_batch_rows_per_sec": med(
                     "rec_shuffled_batch"
                 ),
+                "recordio_shuffled_window_rows_per_sec": med(
+                    "rec_shuffled_window"
+                ),
+                # window/record speedup is THE tentpole acceptance
+                # number (ISSUE 1: >= 5x on the same host); the io
+                # shapes prove WHY — spans ≪ records under coalescing,
+                # seeks=0 on the pread fast path
+                "window_vs_record_shuffle_speedup": round(
+                    med("rec_shuffled_window") / max(med("rec_shuffled"), 1e-9),
+                    2,
+                ),
+                "shuffle_io_shapes": {
+                    name: series[name][0].get("io_stats")
+                    for name in (
+                        "rec_shuffled",
+                        "rec_shuffled_batch",
+                        "rec_shuffled_window",
+                    )
+                },
+                "shuffle_window": WINDOW,
+                "shuffle_merge_gap": MERGE_GAP,
                 "csv_staged_rows_per_sec": med("csv_f16"),
                 "libfm_staged_rows_per_sec": med("libfm_f16"),
                 "libsvm_ell_staged_rows_per_sec": med("libsvm_ell_f16"),

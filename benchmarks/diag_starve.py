@@ -4,7 +4,14 @@ Python spin / GIL-releasing numpy copy between puts.
 
 Verdict from the 2026-07-30 runs: no stable correlation — the rate
 swings are dominated by the tunnel's token-bucket state, not by what
-the host does between puts (see diag_link.py and bench.LinkProbe)."""
+the host does between puts (see diag_link.py and bench.LinkProbe).
+
+Second question (``--shuffle``): when the STAGED shuffled config
+starves, is it the read layer? Drain the raw IndexedRecordIOSplitter
+(no parse, no device) in each shuffle mode over the bench shard and
+report rows/s plus the split's seek/span counters — the per-record
+mode's seek storm vs the window mode's coalesced spans is visible here
+without any device noise."""
 
 from __future__ import annotations
 
@@ -53,7 +60,52 @@ def put_loop(bufs, n, between=None):
     }
 
 
+def shuffle_read_modes():
+    """Raw split-layer drain per shuffle mode over the bench shard:
+    rows/s + io_stats, no parse/device in the loop."""
+    import bench
+    from dmlc_core_tpu.io import split as io_split
+
+    bench.ensure_rec_data()
+    bench.ensure_rec_index()
+    out = {}
+    for mode, extra in (
+        ("0", ""),
+        ("1", ""),
+        ("batch", "&batch_size=4096"),
+        (
+            "window",
+            f"&window={bench.WINDOW}&merge_gap={bench.MERGE_GAP}",
+        ),
+    ):
+        uri = (
+            f"{bench.REC_DATA}?index={bench.REC_INDEX}"
+            f"&shuffle={mode}{extra}"
+        )
+        s = io_split.create(uri, type="recordio", threaded=False)
+        t0 = time.perf_counter()
+        nbytes = 0
+        while True:
+            chunk = s.next_batch(4096)
+            if chunk is None:
+                break
+            nbytes += len(chunk)
+        dt = time.perf_counter() - t0
+        stats = getattr(s, "io_stats", lambda: None)() or {}
+        s.close()
+        out[f"shuffle_{mode}"] = {
+            "rows_per_sec": round(stats.get("records", 0) / dt, 1),
+            "mb_per_sec": round(nbytes / dt / 1e6, 1),
+            "secs": round(dt, 3),
+            **stats,
+        }
+    return out
+
+
 def main():
+    if "--shuffle" in sys.argv:
+        print(json.dumps(shuffle_read_modes(), indent=1))
+        return
     import jax
 
     jax.local_devices()

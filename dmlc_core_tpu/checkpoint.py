@@ -590,8 +590,11 @@ class Checkpointer:
         return f"{self.base}/ckpt-{step:010d}{ext}"
 
     def _meta_path(self, step: int) -> str:
-        # sidecar for the legacy .bin layout; written BEFORE the main
-        # rename so a visible .bin implies its metadata landed.
+        # sidecar for the legacy .bin layout; _write_single clears any
+        # stale sidecar, lands the tree, THEN writes the new sidecar —
+        # so a visible sidecar always belongs to the visible .bin, and
+        # the only crash window leaves a .bin with no sidecar
+        # (restore_meta → None → position-unknown replay, never a skip).
         # (The name doesn't match _PAT — sidecars are invisible to the
         # step scan.) Sharded .d checkpoints carry meta in the manifest.
         return f"{self.base}/ckpt-{step:010d}.meta.bin"
@@ -791,7 +794,12 @@ class Checkpointer:
                     meta=meta,
                 )
                 if proc == 0:
+                    # remove the superseded legacy .bin AND its meta
+                    # sidecar: a surviving sidecar would hand a later
+                    # single-layout restore_meta(step) stale position
+                    # data for a step whose tree is the .d
                     _remove_uri(self._path(step))
+                    _remove_uri(self._meta_path(step))
                     self._prune()
                     log_info(
                         f"async sharded checkpoint step {step} -> {path}"
@@ -848,8 +856,12 @@ class Checkpointer:
                 meta=meta,
             )
             if self._is_writer():
-                # a same-step legacy .bin would now be stale data
+                # a same-step legacy .bin would now be stale data — and
+                # so would its .meta.bin sidecar: drop both, or a later
+                # restore_meta(step) could serve a stale position for a
+                # step whose tree lives in the .d
                 _remove_uri(self._path(step))
+                _remove_uri(self._meta_path(step))
                 self._prune()
                 log_info(f"sharded checkpoint step {step} -> {path}")
             return path

@@ -24,7 +24,7 @@ from __future__ import annotations
 import queue
 import threading
 import time as _time
-from typing import Callable, Generic, Iterable, Iterator, Optional, TypeVar
+from typing import Callable, Generic, Iterable, Iterator, Optional, Tuple, TypeVar
 
 T = TypeVar("T")
 
@@ -91,19 +91,22 @@ class ThreadedIter(Generic[T]):
 
     def _stop(
         self, timeout: Optional[float] = None
-    ) -> Optional[BaseException]:
-        """Tear down the producer; returns any pending producer exception
-        found while draining (must not be silently lost — reference
-        rethrows in BeforeFirst, threadediter.h:406-435).
+    ) -> Tuple[Optional[BaseException], bool]:
+        """Tear down the producer; returns ``(pending, joined)`` — any
+        pending producer exception found while draining (must not be
+        silently lost — reference rethrows in BeforeFirst,
+        threadediter.h:406-435) and whether the producer thread actually
+        exited.
 
         With ``timeout``, a producer thread that stays alive past the
         deadline — blocked in user code (slow upstream IO) that Python
-        cannot interrupt — is orphaned instead of joined: the kill flag
-        is set, so the daemon thread exits at its next queue put, and
-        the caller's teardown doesn't wedge for the stall's duration."""
+        cannot interrupt — is orphaned instead of joined (``joined``
+        False): the kill flag is set, so the daemon thread exits at its
+        next queue put, and the caller's teardown doesn't wedge for the
+        stall's duration."""
         t = self._thread
         if t is None:
-            return None
+            return None, True
         pending: Optional[BaseException] = None
         self._kill.set()
         deadline = None if timeout is None else _time.monotonic() + timeout
@@ -124,8 +127,9 @@ class ThreadedIter(Generic[T]):
                     pending = val
             except queue.Empty:
                 break
+        joined = not t.is_alive()
         self._thread = None
-        return pending
+        return pending, joined
 
     # -- consumer side -------------------------------------------------------
     def next(self) -> Optional[T]:
@@ -152,16 +156,17 @@ class ThreadedIter(Generic[T]):
         """Restart the producer from the beginning; re-raises a pending
         producer exception instead of discarding it (reference
         threadediter.h kBeforeFirst signal + ThrowExceptionIfSet)."""
-        pending = self._stop()
+        pending, _joined = self._stop()
         if pending is not None and not self._exhausted:
             self._exhausted = True
             raise pending
         self._start()
 
-    def destroy(self, timeout: Optional[float] = None) -> None:
+    def destroy(self, timeout: Optional[float] = None) -> bool:
         """Tear down the producer thread (reference ~ThreadedIter).
         Pending exceptions are intentionally dropped here — destruction
-        must not raise.
+        must not raise. Returns whether the producer thread actually
+        exited (always True without a timeout).
 
         The default joins the producer to completion — callers that
         reuse a shared resource afterwards (CachedInputSplit's
@@ -171,9 +176,10 @@ class ThreadedIter(Generic[T]):
         uninterruptible IO is worse than orphaning the daemon thread
         (it exits at its next queue put; StagingPipeline.close does
         this, accepting that the caller must not tear down the
-        producer's underlying resources while a stall is suspected)."""
+        producer's underlying resources while a stall is suspected —
+        the False return is the signal to defer that teardown)."""
         self._destroyed = True
-        self._stop(timeout=timeout)
+        _pending, joined = self._stop(timeout=timeout)
         # wake any consumer blocked in next()'s queue.get() — without
         # this, a downstream stage's thread blocked on THIS iterator
         # (StagingPipeline's transfer thread pulling the parse queue)
@@ -183,6 +189,7 @@ class ThreadedIter(Generic[T]):
             self._queue.put_nowait((_END, None))
         except queue.Full:
             pass  # consumer has items to drain; it isn't blocked
+        return joined
 
     def __del__(self) -> None:
         try:

@@ -142,9 +142,13 @@ def create_row_block_iter(
     if spec.cache_file:
         # a warm cache never touches the raw data source — which is also
         # why epoch shuffling cannot ride it: the first epoch's order
-        # would be frozen into the cache (same guard as io_split.create)
+        # would be frozen into the cache (same guard as io_split.create).
+        # normalize_shuffle understands every spelling of the option
+        # (0/1/record/batch/window) — uri_int here would crash on the
+        # string modes instead of explaining the real conflict
         if uri_int(spec.args, "shuffle_parts", 0) or (
-            "index" in spec.args and uri_int(spec.args, "shuffle", 0)
+            "index" in spec.args
+            and io_split.normalize_shuffle(spec.args.get("shuffle", "0"))
         ):
             raise Error(
                 "epoch shuffling with a #cachefile would freeze the first "

@@ -19,9 +19,10 @@ inner loops when present.
 from __future__ import annotations
 
 import bisect
+import os
 import random
 import re
-from typing import Iterator, List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -48,6 +49,8 @@ __all__ = [
     "CachedInputSplit",
     "InputSplitShuffle",
     "create",
+    "normalize_shuffle",
+    "plan_coalesced_spans",
 ]
 
 # 8 MB chunk buffer (reference kBufferSize = 2<<20 uint32 words,
@@ -467,6 +470,172 @@ class RecordIOSplitter(InputSplitBase):
             yield bytes(rec)
 
 
+def normalize_shuffle(v):
+    """Canonicalize a shuffle option (keyword arg or URI string).
+
+    None/0/False → False (off); 'record'/1/True → per-record shuffle
+    (reference semantics); 'batch'/2 → coalesced span shuffle;
+    'window'/3 → windowed shuffle with coalesced I/O. One resolver for
+    the factory and every URI-sugar guard, so option parsing cannot
+    drift between call sites."""
+    if v in (None, False, 0, "0", ""):
+        return False
+    if v in ("batch", 2, "2"):
+        return "batch"
+    if v in ("window", 3, "3"):
+        return "window"
+    if v in ("record", "1", 1, True):
+        return "record"
+    raise Error(f"invalid shuffle={v!r}: use 0/1/record/batch/window")
+
+
+def _plan_span_bounds(
+    offs: np.ndarray, sizes: np.ndarray, merge_gap: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized span-planner core: offset-sort the records, then cut
+    the sorted run wherever the byte gap from the running span end to
+    the next record's start exceeds ``merge_gap``.
+
+    Returns ``(order, starts, ends)``: ``order`` indexes the inputs
+    offset-sorted; span j covers sorted positions
+    ``order[starts[j]:ends[j]]``. This is the hot path (one call per
+    shuffle window, arrays the size of the window); the tuple-level
+    ``plan_coalesced_spans`` wraps it for callers and tests."""
+    order = np.argsort(offs, kind="stable")
+    soffs = offs[order]
+    # running max handles entries contained inside a predecessor
+    run_end = np.maximum.accumulate(soffs + sizes[order])
+    breaks = np.flatnonzero(soffs[1:] - run_end[:-1] > merge_gap) + 1
+    starts = np.concatenate(([0], breaks))
+    ends = np.concatenate((breaks, [len(offs)]))
+    return order, starts, ends
+
+
+def plan_coalesced_spans(
+    entries: List[Tuple[int, int, int]], merge_gap: int
+) -> List[Tuple[int, int, List[Tuple[int, int, int]]]]:
+    """Coalesce record reads into large contiguous spans.
+
+    ``entries`` is ``[(offset, size, tag), ...]`` in any order; the
+    planner sorts by offset and merges a record into the preceding span
+    when the gap between the span's end and the record's start is at
+    most ``merge_gap`` bytes (0 merges only byte-adjacent records).
+    Returns ``[(span_begin, span_end, members)]`` with ``members`` the
+    entries the span covers, offset-sorted — one positioned read per
+    span serves every member, trading at most ``merge_gap`` wasted
+    bytes per merge for one less seek."""
+    if not entries:
+        return []
+    offs = np.asarray([e[0] for e in entries], dtype=np.int64)
+    sizes = np.asarray([e[1] for e in entries], dtype=np.int64)
+    order, starts, ends = _plan_span_bounds(offs, sizes, merge_gap)
+    out: List[Tuple[int, int, List[Tuple[int, int, int]]]] = []
+    for s, e in zip(starts.tolist(), ends.tolist()):
+        members = [entries[i] for i in order[s:e].tolist()]
+        span_end = max(m[0] + m[1] for m in members)
+        out.append((members[0][0], span_end, members))
+    return out
+
+
+class _SpanReader:
+    """Positioned span reads over a split's file table, by absolute
+    dataset offset (spans may cross file boundaries — the index is
+    global).
+
+    Local files are served via ``os.pread`` on cached descriptors: no
+    seek syscall, no shared stream cursor, so the window-shuffle
+    readahead thread can read while the consumer thread drains —
+    without racing ``InputSplitBase._fs``. Remote backends fall back to
+    one private SeekStream per file (seek+read pairs, counted in
+    ``seeks``)."""
+
+    def __init__(
+        self,
+        files: List[FileInfo],
+        file_offset: List[int],
+        filesys: FileSystem,
+    ) -> None:
+        self._files = files
+        self._file_offset = file_offset
+        self._filesys = filesys
+        self._fds: Dict[int, int] = {}
+        self._streams: Dict[int, SeekStream] = {}
+        self.seeks = 0
+
+    def _local_path(self, fp: int) -> Optional[str]:
+        path = self._files[fp].path
+        if path.startswith("file://"):
+            return path[len("file://"):]
+        return None if "://" in path else path
+
+    def _read_in_file(self, fp: int, rel_off: int, size: int) -> bytes:
+        fd = self._fds.get(fp)
+        if fd is None and fp not in self._streams:
+            local = self._local_path(fp)
+            if local is not None:
+                fd = os.open(local, os.O_RDONLY)
+                self._fds[fp] = fd
+            else:
+                s = self._filesys.open(self._files[fp].path, "r")
+                check(
+                    isinstance(s, SeekStream), "input files must be seekable"
+                )
+                self._streams[fp] = s  # type: ignore[assignment]
+        out: List[bytes] = []
+        if fd is not None:
+            while size > 0:
+                data = os.pread(fd, size, rel_off)
+                if not data:
+                    break
+                out.append(data)
+                rel_off += len(data)
+                size -= len(data)
+        else:
+            stream = self._streams[fp]
+            stream.seek(rel_off)
+            self.seeks += 1
+            while size > 0:
+                data = stream.read(size)
+                if not data:
+                    break
+                out.append(data)
+                size -= len(data)
+        return out[0] if len(out) == 1 else b"".join(out)
+
+    def read(self, offset: int, size: int) -> bytes:
+        out: List[bytes] = []
+        while size > 0:
+            fp = bisect.bisect_right(self._file_offset, offset) - 1
+            if fp >= len(self._files):
+                break
+            avail = self._file_offset[fp + 1] - offset
+            if avail <= 0:
+                break
+            take = min(size, avail)
+            data = self._read_in_file(
+                fp, offset - self._file_offset[fp], take
+            )
+            if not data:
+                break
+            out.append(data)
+            offset += len(data)
+            size -= len(data)
+            if len(data) < take:
+                break
+        return out[0] if len(out) == 1 else b"".join(out)
+
+    def close(self) -> None:
+        for fd in self._fds.values():
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        self._fds.clear()
+        for s in self._streams.values():
+            s.close()
+        self._streams.clear()
+
+
 class IndexedRecordIOSplitter(RecordIOSplitter):
     """Shards by RECORD COUNT via an external index file, with optional
     per-epoch shuffled batched reads (reference
@@ -487,6 +656,20 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
       makes (the reference's own ImageRecordIter-style consumers
       re-shuffle in a client-side buffer); sequential-read throughput at
       shuffle granularity ``batch_size``.
+    - ``'window'``: full per-record permutation (identical epoch order
+      to ``'record'`` for the same seed) with COALESCED I/O — the
+      permutation is cut into windows of ``window`` records, each
+      window's index entries are sorted by byte offset and merged into
+      large spans (``plan_coalesced_spans``, gap threshold
+      ``merge_gap``), the spans are read with one positioned read each
+      (``os.pread`` on local files — no seek syscalls, thread-safe),
+      and the window's records are emitted from the client-side buffer
+      in permutation order. A ThreadedIter readahead stage loads window
+      k+1's spans while the consumer drains window k. Memory is bounded
+      by ~2-3 windows of records; read amplification is bounded by the
+      merged gap bytes. This is input_split_shuffle.h's macro-shuffle
+      trick taken to its limit: record-perfect randomness at
+      near-sequential read cost.
     """
 
     KRAND_MAGIC = 111  # reference indexed_recordio_split.h:82
@@ -502,6 +685,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         seed: int = 0,
         epoch: int = 0,
         skip_records: int = 0,
+        window: int = 65536,
+        merge_gap: int = 65536,
+        readahead: bool = True,
         filesys: Optional[FileSystem] = None,
     ) -> None:
         """``epoch``/``skip_records``: data-position fast-forward (§5.4
@@ -511,21 +697,49 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         state), which makes any epoch's read order reproducible without
         replaying the epochs before it. ``skip_records`` skips that many
         records of the starting epoch arithmetically (no I/O); in
-        ``shuffle='batch'`` mode it must land on a span boundary — the
-        positions a batch-granular consumer naturally checkpoints at."""
-        if shuffle in (False, None, 0):
-            self.shuffle_mode: Optional[str] = None
-        elif shuffle in ("batch", 2):
-            self.shuffle_mode = "batch"
-        else:
-            self.shuffle_mode = "record"
+        ``shuffle='batch'`` mode it must land on a span boundary and in
+        ``shuffle='window'`` on a window boundary — the positions a
+        batch-/window-granular consumer naturally checkpoints at.
+
+        ``window``/``merge_gap``/``readahead`` apply to
+        ``shuffle='window'``: records per shuffle window, the byte gap
+        up to which adjacent reads coalesce into one span, and whether
+        a background thread prefetches the next window's spans."""
+        # one resolver with the factory/URI path (normalize_shuffle), so
+        # a typo'd mode raises here too instead of silently degrading to
+        # the per-record seek storm
+        mode = normalize_shuffle(shuffle)
+        self.shuffle_mode: Optional[str] = mode if mode else None
         self.shuffle = self.shuffle_mode is not None
         self.batch_size = batch_size
+        check(window >= 1, f"window={window} must be >= 1")
+        check(merge_gap >= 0, f"merge_gap={merge_gap} must be >= 0")
+        self.window = window
+        self.merge_gap = merge_gap
+        self._readahead = readahead
+        # window-shuffle pipeline state (set before super().__init__ —
+        # reset_partition/before_first run inside it and tear these
+        # down). A loaded window is (buf, rel, size): span bytes plus
+        # per-record start/length in permutation order.
+        _WinBuf = Tuple[np.ndarray, np.ndarray, np.ndarray]
+        self._win_iter: Optional[ThreadedIter[_WinBuf]] = None
+        self._win_gen: Optional[Iterator[_WinBuf]] = None
+        self._win_buf: Optional[_WinBuf] = None
+        self._win_pos = 0
+        self._win_start = 0
+        self._span_reader: Optional[_SpanReader] = None
+        # I/O-shape counters (cumulative across epochs; io_stats())
+        self.spans_read = 0
+        self.seek_calls = 0
+        self.bytes_read = 0
+        self.records_emitted = 0
         self._seed = seed
         self.epoch = epoch - 1  # before_first() increments into `epoch`
         self._skip_next = skip_records
         self.records_consumed = 0
         self._index: List[Tuple[int, int]] = []  # (offset, size)
+        self._index_offs = np.empty(0, dtype=np.int64)
+        self._index_sizes = np.empty(0, dtype=np.int64)
         self._index_uri = index_uri
         self.index_begin = 0
         self.index_end = 0
@@ -546,6 +760,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             (offsets[i], (offsets[i + 1] if i + 1 < len(offsets) else total) - offsets[i])
             for i in range(len(offsets))
         ]
+        # numpy mirror of the index for the window-shuffle span planner
+        # (vectorized gather + argsort over whole windows)
+        self._index_offs = np.asarray(offsets, dtype=np.int64)
+        self._index_sizes = np.concatenate(
+            (np.diff(self._index_offs), [total - offsets[-1]])
+        ).astype(np.int64)
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         """Record-count range (reference indexed_recordio_split.cc:12-41)."""
@@ -561,6 +781,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._n_overflow = 0
             self._overflow = b""
             self._rec_iter = None
+            self._teardown_window_pipeline()
             self._close_fs()
             return
         self.index_begin = part_index * nstep
@@ -604,7 +825,15 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             if full_end < self.index_end:
                 self._permutation.append(full_end)
             self._current = 0
-        elif self.shuffle_mode == "record":
+        elif self.shuffle_mode in ("record", "window"):
+            # tear the previous epoch's readahead down FIRST: a live
+            # producer slicing a half-built permutation would issue (and
+            # count) span reads for a window that is about to be thrown
+            # away
+            self._teardown_window_pipeline()
+            # window mode emits the SAME (seed, epoch) permutation as
+            # record mode — the window machinery only changes how the
+            # bytes reach the buffer, never the order they leave it
             self._permutation = list(range(self.index_begin, self.index_end))
             rnd.shuffle(self._permutation)
             self._current = 0
@@ -639,6 +868,16 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 )
                 done += span
                 self._current += 1
+        elif self.shuffle_mode == "window":
+            check(
+                n % self.window == 0 or n == total,
+                f"skip_records={n} lands inside a shuffled window of "
+                f"{self.window} (checkpoint at window boundaries — "
+                f"window={self.window} multiples)",
+            )
+            self._win_start = (
+                self._n_windows() if n == total else n // self.window
+            )
         elif self.shuffle_mode == "record":
             self._current = n
         else:
@@ -669,12 +908,191 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             out.append(data)
             nleft -= len(data)
             self.offset_curr += len(data)
+        self.seek_calls += 1
+        self.spans_read += 1
+        self.bytes_read += size - nleft
         return b"".join(out)
+
+    # -- window-shuffle machinery -------------------------------------------
+    def _n_windows(self) -> int:
+        return -(-len(self._permutation) // self.window)
+
+    def _teardown_window_pipeline(self) -> None:
+        if self._win_iter is not None:
+            self._win_iter.destroy()
+            self._win_iter = None
+        self._win_gen = None
+        self._win_buf = None
+        self._win_pos = 0
+        self._win_start = 0
+
+    def _load_window(
+        self, k: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Read window k's records via coalesced spans. Returns the
+        client-side shuffle buffer ``(buf, rel, size)``: one uint8
+        buffer of span bytes plus each record's start offset and length
+        in PERMUTATION order — the emission path gathers records out
+        with vectorized fancy indexing, no per-record Python.
+
+        When the merged gaps more than double the buffer (aggressive
+        ``merge_gap`` over a sparse window), the buffer is compacted to
+        the records' own bytes with one extra gather, bounding resident
+        memory at ~the window's record bytes."""
+        W = self.window
+        perm = np.asarray(
+            self._permutation[k * W : (k + 1) * W], dtype=np.int64
+        )
+        offs = self._index_offs[perm]
+        sizes = self._index_sizes[perm]
+        order, starts, ends = _plan_span_bounds(
+            offs, sizes, self.merge_gap
+        )
+        if self._span_reader is None:
+            self._span_reader = _SpanReader(
+                self.files, self.file_offset, self.filesys
+            )
+        soffs = offs[order]
+        s_sorted = sizes[order]
+        run_end = np.maximum.accumulate(soffs + s_sorted)
+        span_begin = soffs[starts]
+        span_len = run_end[ends - 1] - span_begin
+        parts: List[bytes] = []
+        for begin, nbytes in zip(span_begin.tolist(), span_len.tolist()):
+            data = self._span_reader.read(begin, nbytes)
+            check_eq(len(data), nbytes, "span read truncated")
+            parts.append(data)
+            self.spans_read += 1
+            self.bytes_read += nbytes
+        buf = np.frombuffer(
+            parts[0] if len(parts) == 1 else b"".join(parts),
+            dtype=np.uint8,
+        )
+        # each sorted entry's start inside buf: offset within its span
+        # + the span's base in the concatenation
+        counts = ends - starts
+        span_base = np.concatenate(([0], np.cumsum(span_len)[:-1]))
+        rel_sorted = (
+            soffs - np.repeat(span_begin, counts)
+            + np.repeat(span_base, counts)
+        )
+        idt = np.int32 if len(buf) < (1 << 31) else np.int64
+        rec_bytes = int(s_sorted.sum())
+        if len(buf) > 2 * rec_bytes:
+            base = np.cumsum(s_sorted) - s_sorted
+            gather = np.arange(rec_bytes, dtype=idt) + np.repeat(
+                (rel_sorted - base).astype(idt), s_sorted
+            )
+            buf = buf[gather]
+            rel_sorted = base
+        rel = np.empty(len(rel_sorted), dtype=idt)
+        rel[order] = rel_sorted.astype(idt)  # sorted → permutation order
+        stride = int(sizes[0]) if len(sizes) else 0
+        if (
+            stride
+            and int(sizes.min()) == stride == int(sizes.max())
+            and len(buf) % stride == 0
+            and not (rel % stride).any()
+        ):
+            # uniform-stride window (fixed-size records, the common
+            # RecordIO-shard shape): emit via 2D row gather — one fancy
+            # index at memcpy speed, no per-byte index arrays
+            return buf.reshape(-1, stride), rel // stride, None
+        return buf, rel, sizes.astype(idt)
+
+    def _window_stream(
+        self,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        for k in range(self._win_start, self._n_windows()):
+            yield self._load_window(k)
+
+    def _refill_window(self) -> bool:
+        """Pull the next loaded window into the emission buffer; False
+        at end of epoch."""
+        if self._readahead:
+            if self._win_iter is None:
+                # lazy start: before_first/_fast_forward have fixed
+                # _win_start by the time the first record is pulled
+                self._win_iter = ThreadedIter(
+                    self._window_stream,
+                    max_capacity=2,
+                    name="split-window-readahead",
+                )
+            nxt = self._win_iter.next()
+        else:
+            if self._win_gen is None:
+                self._win_gen = self._window_stream()
+            nxt = next(self._win_gen, None)
+        if nxt is None:
+            return False
+        self._win_buf = nxt
+        self._win_pos = 0
+        return True
+
+    def _emit_from_window(self, n: int) -> Tuple[int, List[bytes]]:
+        """Gather up to ``n`` records (in permutation order) out of the
+        buffered windows; returns (count, chunks). One vectorized fancy
+        index per window touched — no per-record Python."""
+        got = 0
+        chunks: List[bytes] = []
+        while got < n:
+            buf_state = self._win_buf
+            if buf_state is None or self._win_pos >= len(buf_state[1]):
+                if not self._refill_window():
+                    break
+                buf_state = self._win_buf
+            buf, rel, size = buf_state  # type: ignore[misc]
+            take = min(n - got, len(rel) - self._win_pos)
+            r = rel[self._win_pos : self._win_pos + take]
+            if size is None:
+                # uniform-stride: r holds row indices into the 2D buffer
+                chunks.append(buf[r].tobytes())
+            else:
+                s = size[self._win_pos : self._win_pos + take]
+                total = int(s.sum())
+                # output cursor per record, then shift each run to its
+                # record's start in buf
+                base = np.cumsum(s, dtype=r.dtype) - s
+                gather = np.arange(total, dtype=r.dtype) + np.repeat(
+                    r - base, s
+                )
+                chunks.append(buf[gather].tobytes())
+            self._win_pos += take
+            got += take
+        return got, chunks
+
+    def io_stats(self) -> Dict[str, object]:
+        """I/O-shape counters, cumulative since construction: ``spans``
+        positioned reads issued, ``seeks`` stream seek() calls (0 on
+        the local pread fast path), ``bytes_read``, and ``records`` —
+        records actually emitted (skip_records fast-forward excluded).
+        Coalescing shows up as spans ≪ records."""
+        seeks = self.seek_calls
+        if self._span_reader is not None:
+            seeks += self._span_reader.seeks
+        return {
+            "mode": self.shuffle_mode or "sequential",
+            "records": self.records_emitted,
+            "spans": self.spans_read,
+            "seeks": seeks,
+            "bytes_read": self.bytes_read,
+        }
 
     def next_batch_ex(self, n_records: int) -> Optional[bytes]:
         """Reference NextBatchEx (indexed_recordio_split.cc:159-212):
         record-shuffled = per-record seeks; batch-shuffled = one
-        coalesced seek per permuted span; sequential = one span."""
+        coalesced seek per permuted span; window-shuffled = coalesced
+        spans refilling a client-side shuffle buffer (readahead thread);
+        sequential = one span."""
+        if self.shuffle_mode == "window":
+            n = self._n_overflow or n_records
+            got, chunks = self._emit_from_window(n)
+            if not got:
+                return None
+            self._n_overflow = n - got
+            self.records_consumed += got
+            self.records_emitted += got
+            return chunks[0] if len(chunks) == 1 else b"".join(chunks)
         if self.shuffle_mode == "batch":
             if self._current >= len(self._permutation):
                 return None
@@ -690,6 +1108,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             chunk = self._read_at(begin_off, end_off - begin_off)
             if chunk:
                 self.records_consumed += e - s
+                self.records_emitted += e - s
             return chunk if chunk else None
         if self.shuffle:
             n = self._n_overflow or n_records
@@ -702,6 +1121,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 return None
             self._n_overflow = n - len(parts)
             self.records_consumed += len(parts)
+            self.records_emitted += len(parts)
             return b"".join(parts)
         n = self._n_overflow or n_records
         last = min(self._current + n, self.index_end)
@@ -715,8 +1135,16 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         chunk = self._read_at(begin_off, end_off - begin_off)
         if chunk:
             self.records_consumed += last - self._current
+            self.records_emitted += last - self._current
         self._current = last
         return chunk if chunk else None
+
+    def close(self) -> None:
+        self._teardown_window_pipeline()
+        if self._span_reader is not None:
+            self._span_reader.close()
+            self._span_reader = None
+        super().close()
 
     def next_chunk(self) -> Optional[bytes]:
         return self.next_batch_ex(self.batch_size)
@@ -870,6 +1298,12 @@ class ThreadedInputSplit(InputSplit):
 
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
+
+    def io_stats(self) -> Optional[Dict[str, object]]:
+        """Forward the wrapped split's I/O-shape counters (indexed
+        splits), or None when the base doesn't track them."""
+        fn = getattr(self._base, "io_stats", None)
+        return fn() if fn is not None else None
 
     def close(self) -> None:
         self._iter.destroy()
@@ -1043,13 +1477,20 @@ def create(
     threaded: bool = True,
     epoch: int = 0,
     skip_records: int = 0,
+    window: Optional[int] = None,
+    merge_gap: Optional[int] = None,
 ) -> InputSplit:
     """InputSplit factory (reference InputSplit::Create, src/io.cc:81-130).
 
     - ``uri`` may carry ``#cachefile`` sugar → CachedInputSplit
       (reference io.cc:120-124)
-    - default wraps the split in a read-ahead thread (reference io.cc:119-122)
+    - default wraps the split in a read-ahead thread (reference
+      io.cc:119-122); ``shuffle='window'`` splits prefetch internally
+      (their readahead thread loads coalesced spans) and are returned
+      bare — cached OR threaded OR window-readahead, never stacked
     - ``type``: 'text' | 'recordio' | 'indexed_recordio'
+    - ``window``/``merge_gap``: shuffle='window' knobs
+      (``?shuffle=window&window=N&merge_gap=B`` as URI sugar)
     """
     check(
         num_parts >= 1 and 0 <= part_index < num_parts,
@@ -1069,23 +1510,16 @@ def create(
         type = "indexed_recordio"
     if seed == 0:
         seed = uri_int(spec.args, "seed", 0)
-    def norm_shuffle(v):
-        """None/0/False → off; 'batch'/2 → coalesced span shuffle;
-        'record'/1/True → per-record shuffle (reference semantics)."""
-        if v in (None, False, 0, "0", ""):
-            return False
-        if v in ("batch", 2, "2"):
-            return "batch"
-        if v in ("record", "1", 1, True):
-            return "record"
-        raise Error(f"invalid shuffle={v!r}: use 0/1/record/batch")
-
     if type == "indexed_recordio":
         if shuffle is None:
             shuffle = spec.args.get("shuffle", "0")
-        shuffle = norm_shuffle(shuffle)
+        shuffle = normalize_shuffle(shuffle)
         if batch_size is None:
             batch_size = uri_int(spec.args, "batch_size", 256)
+        if window is None:
+            window = uri_int(spec.args, "window", 65536, minimum=1)
+        if merge_gap is None:
+            merge_gap = uri_int(spec.args, "merge_gap", 65536, minimum=0)
         # data-position resume sugar (?epoch=E&skip_records=N): start at
         # epoch E's deterministic permutation, N records in (§5.4)
         if epoch == 0:
@@ -1098,7 +1532,7 @@ def create(
             "epoch's shuffle order into the cache; pick one",
         )
     else:
-        shuffle = norm_shuffle(shuffle)
+        shuffle = normalize_shuffle(shuffle)
         # position fast-forward needs count-indexed access; silently
         # starting at record 0 would make a resume retrain duplicate
         # data — refuse loudly (the check() idiom of the sugar below)
@@ -1133,6 +1567,10 @@ def create(
             seed=seed,
             epoch=epoch,
             skip_records=skip_records,
+            # the indexed branch above resolved both (kwarg > URI >
+            # default), so they are never None here
+            window=window,  # type: ignore[arg-type]
+            merge_gap=merge_gap,  # type: ignore[arg-type]
         )
     else:
         raise Error(f"unknown InputSplit type {type!r}")
@@ -1153,6 +1591,14 @@ def create(
         # cached OR threaded, never both: CachedInputSplit prefetches
         # internally (reference io.cc:119-124 chooses exactly one wrapper)
         return CachedInputSplit(base, spec.cache_file)
+    if (
+        isinstance(base, IndexedRecordIOSplitter)
+        and base.shuffle_mode == "window"
+    ):
+        # window mode already prefetches on its own readahead thread
+        # (coalesced spans for window k+1 load while k drains); stacking
+        # a ThreadedInputSplit would add a queue without overlap
+        return base
     if threaded:
         return ThreadedInputSplit(base)
     return split

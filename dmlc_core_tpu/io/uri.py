@@ -12,22 +12,32 @@ The cache file gets a ``.splitN.partK`` suffix per shard
 
 from __future__ import annotations
 
-from typing import Dict, Mapping
+from typing import Dict, Mapping, Optional
 
 __all__ = ["URI", "URISpec", "uri_int", "rejoin_query"]
 
 
-def uri_int(args: Mapping[str, str], key: str, default: int) -> int:
-    """Integer URI option with an error that names the bad parameter."""
+def uri_int(
+    args: Mapping[str, str],
+    key: str,
+    default: int,
+    minimum: Optional[int] = None,
+) -> int:
+    """Integer URI option with an error that names the bad parameter.
+    ``minimum`` rejects out-of-range values with the same loud error
+    (e.g. ``?window=0`` must not silently build a degenerate split)."""
     from ..utils.logging import Error  # local import: logging imports nothing back
 
     raw = args.get(key)
     if raw is None:
         return default
     try:
-        return int(raw)
+        value = int(raw)
     except (TypeError, ValueError):
         raise Error(f"URI option {key}={raw!r} is not an integer") from None
+    if minimum is not None and value < minimum:
+        raise Error(f"URI option {key}={value} must be >= {minimum}")
+    return value
 
 
 def rejoin_query(args: Mapping[str, str]) -> str:

@@ -516,7 +516,11 @@ class FusedEllRowRecBatches(_EllSlotMixin):
     consumes raw mmap windows directly (it stops cleanly at a trailing
     partial record, so no boundary pre-scan is needed); sharded/remote URIs
     go through RecordIOSplitter chunks (record-aligned byte-range sharding,
-    reference src/io/recordio_split.cc).
+    reference src/io/recordio_split.cc). Shuffled-epoch reads ride the URI
+    sugar (``?index=<uri>&shuffle=record|batch|window``); the window mode
+    (coalesced spans + readahead, io/split.py) keeps full per-record
+    randomness at near-sequential read cost, and ``io_stats()`` exposes
+    the split's seek/span counters so the I/O shape is observable.
 
     A yielded batch stays valid until ``ring_slots - 1`` further batches
     have been produced.
@@ -576,6 +580,12 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         self.rows_out = 0
         self.truncated_nnz = 0
         self.bad_records = 0
+
+    def io_stats(self):
+        """Seek/span counters from the underlying split (indexed
+        shuffled reads), or None on the mmap/byte-sharded paths."""
+        fn = getattr(self._split, "io_stats", None)
+        return fn() if fn is not None else None
 
     def _emit(self, bufs, n_valid: int) -> Batch:
         return self._emit_ell(bufs, n_valid)
@@ -795,6 +805,27 @@ class ShardedFusedBatches:
     def bad_lines(self) -> int:
         """Aggregated malformed-line count (CSV sub-producers)."""
         return sum(getattr(p, "bad_lines", 0) for p in self._producers)
+
+    def io_stats(self):
+        """Summed seek/span counters across sub-producers that track
+        them (numeric fields add; the mode tag carries over), or None
+        when no sub-producer does."""
+        stats = [
+            s
+            for p in self._producers
+            for s in [getattr(p, "io_stats", lambda: None)()]
+            if s
+        ]
+        if not stats:
+            return None
+        out: dict = {}
+        for s in stats:
+            for k, v in s.items():
+                if isinstance(v, (int, float)):
+                    out[k] = out.get(k, 0) + v
+                else:
+                    out.setdefault(k, v)
+        return out
 
     def __iter__(self) -> Iterator[Batch]:
         active = list(self._iters)
@@ -1090,6 +1121,17 @@ class _GenericBatchStream:
     @property
     def truncated_nnz(self) -> int:
         return self._batcher.truncated_nnz
+
+    def io_stats(self):
+        """Seek/span counters from the parser's source split (indexed
+        shuffled reads), or None — same hook as the fused producers, so
+        the bench sees the I/O shape whichever path served the rows."""
+        parser = getattr(self._parser, "_base", self._parser)
+        source = getattr(
+            parser, "source", getattr(parser, "_source", None)
+        )
+        fn = getattr(source, "io_stats", None)
+        return fn() if fn is not None else None
 
     def __iter__(self) -> Iterator[Batch]:
         return self._batcher.batches(iter(self._parser))

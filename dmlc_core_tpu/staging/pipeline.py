@@ -212,6 +212,11 @@ class StagingPipeline:
         self.rows_staged = 0
         self.batches_staged = 0
         self.bytes_staged = 0
+        # sticky flag set by close() when a bounded teardown join timed
+        # out: an orphaned producer thread may still be reading the host
+        # batch source, so callers must defer tearing down mmap-backed
+        # producers (fused rings, _MmapRawChunks) while this is set
+        self.close_timed_out = False
         # per-stage wall-clock accumulators (seconds); the XProf
         # annotate() spans show the same phases on a trace timeline, but
         # these make the breakdown available programmatically (bench
@@ -311,12 +316,19 @@ class StagingPipeline:
             **{f"secs_{k}": v for k, v in self.stage_seconds.items()},
         }
 
-    def close(self) -> None:
+    def close(self) -> bool:
         # host iterator first: its destroy() wakes the transfer thread
         # if it is blocked pulling the parse queue (stalled upstream IO),
         # so the xfer teardown's join can actually complete. Bounded
         # joins: a producer stalled in uninterruptible IO is orphaned
         # after the timeout rather than wedging close() for the stall's
         # duration (the daemon thread exits at its next queue put).
-        self._host_iter.destroy(timeout=1.0)
-        self._xfer_iter.destroy(timeout=1.0)
+        # Returns False — and latches ``close_timed_out`` — when either
+        # join timed out: the orphaned thread may still touch the host
+        # batch source, so the caller must not tear down mmap-backed
+        # producers until it has actually exited.
+        host_joined = self._host_iter.destroy(timeout=1.0)
+        xfer_joined = self._xfer_iter.destroy(timeout=1.0)
+        if not (host_joined and xfer_joined):
+            self.close_timed_out = True
+        return host_joined and xfer_joined

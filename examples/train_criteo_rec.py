@@ -57,6 +57,23 @@ def synth(path: str, rows: int = 20000) -> None:
     os.replace(tmp, path)
 
 
+def shard_sizes(n_total: int, world: int) -> list:
+    """Per-rank record counts under the splitter's ceil-division count
+    sharding (io/split.py reset_partition): when ``n_total % world !=
+    0`` the tail ranks own fewer records, so one rank's consumed count
+    is NOT a valid resume position for another."""
+    nstep = -(-n_total // world)
+    return [
+        max(0, min((r + 1) * nstep, n_total) - min(r * nstep, n_total))
+        for r in range(world)
+    ]
+
+
+def index_count(idx_path: str) -> int:
+    with open(idx_path) as f:
+        return sum(1 for line in f if line.strip())
+
+
 def main() -> None:
     import jax
 
@@ -99,7 +116,15 @@ def main() -> None:
         gstep, params = ck.restore(start)
         pos = ck.restore_meta(start)
         if pos is not None:
-            start_epoch, skip = int(pos["epoch"]), int(pos["records"])
+            start_epoch = int(pos["epoch"])
+            rec = pos["records"]
+            # per-rank dict (current layout) or a bare count (older
+            # checkpoints: rank 0's count — only exact when every
+            # shard has the same size)
+            if isinstance(rec, dict):
+                skip = int(rec.get(str(rank), 0))
+            else:
+                skip = int(rec)
             print(
                 f"rank {rank}: resumed step {gstep} at epoch "
                 f"{start_epoch}, {skip} records in"
@@ -120,6 +145,7 @@ def main() -> None:
     # in a fresh shuffled order (URI sugar → IndexedRecordIOSplitter);
     # without one, fall back to sequential byte-sharded reads
     has_index = os.path.exists(path + ".idx")
+    sizes = shard_sizes(index_count(path + ".idx"), world) if has_index else []
     for epoch in range(start_epoch, 3):
         # shuffle=batch: permuted SPANS of batch_size records, one
         # coalesced seek per span — sequential-read throughput at
@@ -144,16 +170,26 @@ def main() -> None:
             gstep += 1
             # mid-epoch position checkpoint: only at span-aligned
             # positions (a padded tail batch is not resumable-into; the
-            # epoch-end save right below covers it). Rank 0 writes; with
-            # count-exact index shards every rank is at the same
-            # full-batch position, so rank 0's count speaks for all.
+            # epoch-end save right below covers it). Rank 0 writes the
+            # positions of EVERY rank, keyed by rank: when
+            # ntotal % world != 0 the tail ranks' shards are smaller,
+            # so rank 0's count clamped to each shard's size is that
+            # rank's position (a B-multiple is never strictly inside a
+            # smaller shard's tail span, and a rank whose shard is
+            # already exhausted resumes at its total = skip-everything).
             if (
                 has_index and gstep % SAVE_EVERY == 0
                 and consumed % B == 0
             ):
                 ck.save_async(
                     gstep, params,
-                    meta={"epoch": epoch, "records": consumed},
+                    meta={
+                        "epoch": epoch,
+                        "records": {
+                            str(r): min(consumed, sizes[r])
+                            for r in range(world)
+                        },
+                    },
                 )
         stats = pipe.throughput()
         loss_str = "n/a (empty shard)" if loss is None else f"{float(loss):.4f}"

@@ -12,6 +12,7 @@
 // enforced by tests/test_native.py which parses identical inputs both ways.
 
 #include <array>
+#include <cerrno>
 #include <charconv>
 #include <cctype>
 #include <cstdint>
@@ -98,6 +99,36 @@ inline const char* skip_plus(const char* b, const char* e) {
   return b;
 }
 
+// Floating-point from_chars shim: libstdc++ < 11 (gcc 10 toolchains)
+// ships the integer overloads only — __cpp_lib_to_chars is defined iff
+// the FP overloads exist. The fallback emulates from_chars(general)
+// with glibc strtod (also correctly rounded): bounded copy of the
+// token, hex-float forms cut at the 'x' (strtod would consume "0x1p3"
+// whole; from_chars general stops after the "0"). Callers pre-strip
+// the leading blanks/'+' that strtod would otherwise accept.
+#if defined(__cpp_lib_to_chars)
+inline std::from_chars_result fp_from_chars(const char* b, const char* e,
+                                            double& v) {
+  return std::from_chars(b, e, v);
+}
+#else
+inline std::from_chars_result fp_from_chars(const char* b, const char* e,
+                                            double& v) {
+  std::string tmp(b, e);
+  const size_t x = tmp.find_first_of("xX");
+  if (x != std::string::npos) tmp.resize(x);
+  errno = 0;
+  char* endp = nullptr;
+  const double got = std::strtod(tmp.c_str(), &endp);
+  if (endp == tmp.c_str()) {
+    return {b, std::errc::invalid_argument};
+  }
+  v = got;
+  return {b + (endp - tmp.c_str()),
+          errno == ERANGE ? std::errc::result_out_of_range : std::errc()};
+}
+#endif
+
 // Exact fast path for plain decimals: [sign] up-to-15 digits with one
 // optional dot, no exponent. mantissa < 10^15 < 2^53 and the 10^k divisor
 // are both exact doubles, so one division gives the correctly-rounded
@@ -177,7 +208,7 @@ inline bool parse_float_full(const char* b, const char* e, double* out) {
   if (parse_float_simple(b, e, out)) return true;
   b = skip_plus(b, e);
   if (b == e) return false;
-  auto [ptr, ec] = std::from_chars(b, e, *out);
+  auto [ptr, ec] = fp_from_chars(b, e, *out);
   if (ec == std::errc::result_out_of_range && ptr == e) {
     std::string tmp(b, e);
     *out = std::strtod(tmp.c_str(), nullptr);
@@ -191,7 +222,7 @@ inline double parse_float_prefix(const char* b, const char* e) {
   while (b != e && is_blank(*b)) ++b;
   b = skip_plus(b, e);
   double v = 0.0;
-  auto [ptr, ec] = std::from_chars(b, e, v);
+  auto [ptr, ec] = fp_from_chars(b, e, v);
   (void)ptr;
   if (ec == std::errc::result_out_of_range) {
     std::string tmp(b, e);

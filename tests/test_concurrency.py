@@ -177,8 +177,10 @@ def test_threaded_iter_destroy_bounded_join_orphans_stalled_producer():
     assert it.next() == 1
     time.sleep(0.1)  # let the producer enter the stall
     t0 = time.monotonic()
-    it.destroy(timeout=0.5)
+    joined = it.destroy(timeout=0.5)
     assert time.monotonic() - t0 < 5.0
+    assert joined is False  # orphaned, not joined — callers must defer
+    #                         tearing down resources the thread may touch
     release.set()  # orphan wakes, sees kill, exits without producing
 
 
@@ -198,5 +200,5 @@ def test_threaded_iter_default_destroy_still_joins_fully():
 
     it = ThreadedIter(produce, max_capacity=1)
     assert it.next() == 1
-    it.destroy()  # no timeout: must wait for the producer's finally
+    assert it.destroy() is True  # no timeout: waits for the finally
     assert done == [True]

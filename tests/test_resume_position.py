@@ -138,11 +138,13 @@ def test_skip_records_sequential_and_record_modes(tmp_path):
     from dmlc_core_tpu.io import split as io_split
 
     rec, idx = _write_indexed_rec(tmp_path)
-    for mode in (False, "record"):
+    # window=B makes batch positions window boundaries, so the same
+    # skip is resumable in all three modes
+    for mode in (False, "record", "window"):
         def order(skip):
             s = io_split.IndexedRecordIOSplitter(
                 rec, idx, batch_size=B, shuffle=mode, seed=3,
-                epoch=0, skip_records=skip,
+                epoch=0, skip_records=skip, window=B,
             )
             out = []
             while True:
@@ -196,6 +198,42 @@ def test_checkpointer_meta_roundtrip_sharded(tmp_path):
     h = ck.save_async(4, {"w": w}, meta={"epoch": 5})
     h.result(timeout=60)
     assert ck.restore_meta(4) == {"epoch": 5}
+
+
+def test_sharded_resave_clears_stale_legacy_meta_sidecar(tmp_path):
+    """A sharded re-save of a step that previously saved single-file
+    WITH meta must remove the legacy .meta.bin alongside the .bin —
+    otherwise a later restore_meta for a single-layout step could
+    serve a position no sharded tree ever reached (ADVICE r5)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dmlc_core_tpu.checkpoint import Checkpointer
+    from dmlc_core_tpu.parallel import make_mesh
+
+    ckdir = tmp_path / "ck"
+    single = Checkpointer(str(ckdir), process_index=0)
+    single.save(7, {"w": np.ones(3, np.float32)}, meta={"records": 999})
+    assert (ckdir / "ckpt-0000000007.meta.bin").exists()
+
+    mesh = make_mesh((8,), ("data",))
+    w = jax.device_put(
+        np.arange(8, dtype=np.float32), NamedSharding(mesh, P("data"))
+    )
+    sharded = Checkpointer(str(ckdir), sharded=True)
+    sharded.save(7, {"w": w}, meta={"records": 128})
+    names = set(os.listdir(ckdir))
+    assert "ckpt-0000000007.bin" not in names  # legacy tree gone
+    assert "ckpt-0000000007.meta.bin" not in names  # and its sidecar
+    assert sharded.restore_meta(7) == {"records": 128}
+    # the async sharded path tears the same pair down
+    single.save(8, {"w": np.ones(3, np.float32)}, meta={"records": 111})
+    h = sharded.save_async(8, {"w": w}, meta={"records": 256})
+    h.result(timeout=60)
+    names = set(os.listdir(ckdir))
+    assert "ckpt-0000000008.bin" not in names
+    assert "ckpt-0000000008.meta.bin" not in names
+    assert sharded.restore_meta(8) == {"records": 256}
 
 
 WORKER = """

@@ -311,7 +311,8 @@ def test_pipeline_abandoned_mid_epoch_closes_clean():
     pipe = StagingPipeline(gen())
     it = iter(pipe)
     next(it)  # stage one batch, then abandon with the queue primed
-    pipe.close()
+    assert pipe.close() is True  # clean join: safe to tear down sources
+    assert pipe.close_timed_out is False
 
 
 @pytest.mark.jax
@@ -335,7 +336,11 @@ def test_pipeline_close_does_not_wedge_on_stalled_producer():
     next(it)
     time.sleep(0.2)  # let the producer enter the stall
     t0 = time.perf_counter()
-    pipe.close()
+    clean = pipe.close()
     assert time.perf_counter() - t0 < 5.0
+    # the orphaned producer is reported, so the caller knows NOT to tear
+    # down mmap-backed sources the thread may still be reading
+    assert clean is False
+    assert pipe.close_timed_out is True
     # the abandoned iterator must also see a clean end, not a hang
     assert list(it) == []
