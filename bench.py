@@ -318,7 +318,10 @@ def _make_rec_stream(value_dtype: str):
         value_dtype=np.dtype(value_dtype),
     )
     return (
-        ell_batches(REC_DATA, spec, nthread=_nthread_for(REC_ROWS), ring=_RING),
+        ell_batches(
+            _fault_wrapped(REC_DATA), spec,
+            nthread=_nthread_for(REC_ROWS), ring=_RING,
+        ),
         "values",
         REC_DATA,
     )
@@ -355,6 +358,21 @@ def ensure_rec_index() -> None:
 WINDOW = int(os.environ.get("BENCH_WINDOW", str(1 << 18)))
 MERGE_GAP = int(os.environ.get("BENCH_MERGE_GAP", str(64 << 10)))
 
+# chaos knob: BENCH_FAULT="resets=2,errors=1,seed=7" routes the recordio
+# configs through the fault:// injection layer (docs/robustness.md), so
+# the staged numbers measure the retry layer healing seeded faults and
+# io_stats carries retries/backoff_secs/faults_injected alongside the
+# seek/span shape counters.
+BENCH_FAULT = os.environ.get("BENCH_FAULT", "")
+
+
+def _fault_wrapped(path: str) -> str:
+    if not BENCH_FAULT:
+        return path
+    from dmlc_core_tpu.io.faults import wrap_uri
+
+    return wrap_uri(path, BENCH_FAULT)
+
 
 def _make_rec_shuffled_stream(mode: str):
     """Shuffled-epoch staging — the access pattern training actually
@@ -372,7 +390,8 @@ def _make_rec_shuffled_stream(mode: str):
             value_dtype=np.dtype(value_dtype),
         )
         uri = (
-            f"{REC_DATA}?index={REC_INDEX}&shuffle={mode}&batch_size=4096"
+            f"{_fault_wrapped(REC_DATA)}?index={REC_INDEX}"
+            f"&shuffle={mode}&batch_size=4096"
         )
         if mode == "window":
             uri += f"&window={WINDOW}&merge_gap={MERGE_GAP}"
@@ -490,9 +509,12 @@ def run_epoch(make_stream, value_dtype: str) -> dict:
     # configs): spans ≪ records proves the coalescer is engaged, and
     # seeks=0 proves the local pread fast path carried the spans
     io_stats = getattr(stream, "io_stats", lambda: None)()
-    if hasattr(stream, "close"):
-        stream.close()
-    pipe.close()
+    # pipeline first, source second — and only when the teardown join
+    # completed (close_timed_out): an orphaned producer thread may still
+    # be reading the stream's ring/mmap buffers
+    from dmlc_core_tpu.staging import drain_close
+
+    drain_close(pipe, stream)
     return {
         **({"io_stats": io_stats} if io_stats else {}),
         "rows": pipe.rows_staged,

@@ -29,7 +29,7 @@ import bench  # reuse data generators + stream makers
 def staged_epoch():
     import jax
 
-    from dmlc_core_tpu.staging import StagingPipeline
+    from dmlc_core_tpu.staging import StagingPipeline, drain_close
 
     stream, key, _ = bench._make_rec_stream("float16")
     t0 = time.perf_counter()
@@ -48,8 +48,7 @@ def staged_epoch():
         "batches": n,
         **{k: round(v, 4) for k, v in pipe.stage_seconds.items()},
     }
-    stream.close()
-    pipe.close()
+    drain_close(pipe, stream)
     return out
 
 

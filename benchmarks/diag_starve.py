@@ -60,11 +60,15 @@ def put_loop(bufs, n, between=None):
     }
 
 
-def shuffle_read_modes():
+def shuffle_read_modes(fault: str = ""):
     """Raw split-layer drain per shuffle mode over the bench shard:
-    rows/s + io_stats, no parse/device in the loop."""
+    rows/s + io_stats, no parse/device in the loop. ``fault`` is a
+    fault:// spec (e.g. ``resets=2,errors=1,seed=7``): the drain then
+    exercises the retry layer healing seeded faults, visible as
+    retries/backoff_secs/faults_injected in the per-mode io_stats."""
     import bench
     from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.io.faults import wrap_uri
 
     bench.ensure_rec_data()
     bench.ensure_rec_index()
@@ -79,7 +83,7 @@ def shuffle_read_modes():
         ),
     ):
         uri = (
-            f"{bench.REC_DATA}?index={bench.REC_INDEX}"
+            f"{wrap_uri(bench.REC_DATA, fault)}?index={bench.REC_INDEX}"
             f"&shuffle={mode}{extra}"
         )
         s = io_split.create(uri, type="recordio", threaded=False)
@@ -104,7 +108,10 @@ def shuffle_read_modes():
 
 def main():
     if "--shuffle" in sys.argv:
-        print(json.dumps(shuffle_read_modes(), indent=1))
+        fault = ""
+        if "--fault" in sys.argv:  # e.g. --fault resets=2,errors=1,seed=7
+            fault = sys.argv[sys.argv.index("--fault") + 1]
+        print(json.dumps(shuffle_read_modes(fault), indent=1))
         return
     import jax
 

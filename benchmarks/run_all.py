@@ -154,7 +154,12 @@ def bench_recordio_staged(tmp: str) -> None:
     from dmlc_core_tpu.data.row_block import RowBlock
     from dmlc_core_tpu.data.rowrec import write_rowrec
     from dmlc_core_tpu.io.stream import FileStream
-    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, ell_batches
+    from dmlc_core_tpu.staging import (
+        BatchSpec,
+        StagingPipeline,
+        drain_close,
+        ell_batches,
+    )
 
     rng = np.random.default_rng(3)
     n, k = max(N_ROWS // 2, 1000), 39
@@ -186,8 +191,7 @@ def bench_recordio_staged(tmp: str) -> None:
             pass
         dt = time.perf_counter() - t0
         assert pipe.rows_staged == n
-        stream.close()
-        pipe.close()
+        drain_close(pipe, stream)
         best = min(best, dt)
     RESULTS["recordio_staged_rows_per_sec"] = round(n / best, 1)
     RESULTS["recordio_staged_mb_per_sec"] = round(

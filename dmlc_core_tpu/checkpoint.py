@@ -377,16 +377,65 @@ def _clear_manifest(dir_uri: str) -> None:
         fs.delete(uri)  # raises on failure: torn-only crash invariant
 
 
+class _CountingStream(Stream):
+    """Pass-through write stream tallying bytes, so a remote atomic
+    write can verify the stored object's length before committing."""
+
+    def __init__(self, inner: Stream) -> None:
+        self._inner = inner
+        self.nbytes = 0
+
+    def read(self, n: int = -1) -> bytes:
+        raise Error("_CountingStream is write-only")
+
+    def write(self, data) -> int:
+        out = self._inner.write(data)
+        self.nbytes += len(data)
+        return out
+
+    def flush(self) -> None:
+        self._inner.flush()
+
+    def close(self) -> None:
+        self._inner.close()
+
+
 def _write_atomic(uri: str, tree: Any) -> None:
-    """save_pytree with tmp+rename on local paths (remote writes direct)."""
+    """save_pytree with a write-then-commit discipline on EVERY backend.
+
+    Crash-consistency contract: the final ``uri`` is only ever absent or
+    complete — a crash mid-save can leave debris (a ``.tmp`` file/key),
+    never a torn object readable as a checkpoint.
+
+    - local paths: tmp file + ``os.replace`` (atomic rename).
+    - remote URIs: serialize to ``uri + '.tmp'``, verify the stored
+      length against the bytes written (a truncated upload — connection
+      reset past the retry budget, a lying proxy — fails HERE), then
+      ``FileSystem.rename`` commits it: a true rename where the backend
+      has one (WebHDFS), else server-side copy + delete (S3/GCS) whose
+      ordering still never exposes a partial final key.
+    """
     local = _as_local(uri)
     if local is not None:
         os.makedirs(os.path.dirname(local), exist_ok=True)
         tmp = local + ".tmp"
         save_pytree(tmp, tree)
         os.replace(tmp, local)
-    else:
-        save_pytree(uri, tree)
+        return
+    fs = FileSystem.get_instance(uri)
+    tmp = uri + ".tmp"
+    counter = _CountingStream(fs.open(tmp, "w"))
+    try:
+        save_pytree(counter, tree)
+    finally:
+        counter.close()
+    stored = fs.get_path_info(tmp).size
+    check(
+        stored == counter.nbytes,
+        f"atomic write of {uri}: tmp key holds {stored} bytes, "
+        f"expected {counter.nbytes} — refusing to commit a torn object",
+    )
+    fs.rename(tmp, uri)
 
 
 def load_pytree_sharded(dir_uri: str, template: Any = None) -> Any:

@@ -29,6 +29,10 @@ from .recordio import (  # noqa: F401
     RecordIOChunkReader,
 )
 from . import serializer  # noqa: F401
+from . import retry  # noqa: F401
+from . import faults  # noqa: F401 — registers the fault:// scheme
+from .retry import RetryPolicy, RetryingReadStream  # noqa: F401
+from .faults import FaultInjectingFileSystem  # noqa: F401
 from .split import (  # noqa: F401
     InputSplit,
     InputSplitBase,

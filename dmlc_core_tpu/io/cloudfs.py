@@ -35,14 +35,14 @@ import json
 import os
 import threading
 import time
-import urllib.error
 import urllib.parse
-import urllib.request
 import xml.etree.ElementTree as ET
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils.logging import Error, check
 from .filesystem import FS_REGISTRY, FileInfo, FileSystem
+from .retry import HttpError, RetryPolicy, is_transient
+from .retry import request as _retry_request
 from .stream import SeekStream, Stream
 from .uri import URI
 
@@ -68,19 +68,13 @@ def _request(
     headers: Optional[Dict[str, str]] = None,
     data: Optional[bytes] = None,
     timeout: float = 60.0,
+    policy: Optional[RetryPolicy] = None,
 ):
-    """One HTTP round trip; returns the open response (caller reads/closes).
-    Raises Error with status+body context on HTTP errors."""
-    req = urllib.request.Request(
-        url, data=data, headers=headers or {}, method=method
-    )
-    try:
-        return urllib.request.urlopen(req, timeout=timeout)
-    except urllib.error.HTTPError as e:
-        body = e.read(4096).decode(errors="replace")
-        raise Error(f"{method} {url} -> HTTP {e.code}: {body[:500]}") from e
-    except urllib.error.URLError as e:
-        raise Error(f"{method} {url} failed: {e.reason}") from e
+    """One HTTP round trip with transient-failure retry (io/retry.py
+    owns the policy and the single urlopen call site); returns the open
+    response (caller reads/closes). Raises HttpError (status attached)
+    on HTTP errors, Error on connection failures."""
+    return _retry_request(url, method, headers, data, timeout, policy=policy)
 
 
 class HttpReadStream(SeekStream):
@@ -90,6 +84,14 @@ class HttpReadStream(SeekStream):
     at the new offset on the next read (reference CURLReadStreamBase::Seek,
     s3_filesys.cc:550-593). ``prepare`` customizes each restart (signing,
     offset query params).
+
+    Transient failures — a 5xx on the (re)connect, a socket reset or
+    IncompleteRead mid-body, a silently short body — reconnect with a
+    Range header at the exact resume offset, so the fault is invisible
+    to callers. One ``RetryPolicy`` spans the stream's lifetime: its
+    cumulative backoff budget bounds a stream limping through repeated
+    faults, and the per-operation attempt cap bounds consecutive
+    no-progress reconnects.
     """
 
     def __init__(
@@ -99,10 +101,13 @@ class HttpReadStream(SeekStream):
         prepare: Optional[
             Callable[[int, Dict[str, str]], Tuple[str, Dict[str, str]]]
         ] = None,
+        policy: Optional[RetryPolicy] = None,
     ) -> None:
         self.url = url
         self._size = size
         self._prepare = prepare
+        self._policy = policy or RetryPolicy()
+        self._stalls = 0  # consecutive reconnects without progress
         self._pos = 0
         self._resp = None
 
@@ -118,9 +123,9 @@ class HttpReadStream(SeekStream):
             self._resp = None
             return
         try:
-            self._resp = _request(url, "GET", headers)
-        except Error as e:
-            if "HTTP 416" in str(e):  # range beyond EOF
+            self._resp = _request(url, "GET", headers, policy=self._policy)
+        except HttpError as e:
+            if e.status == 416:  # range beyond EOF
                 self._resp = None
                 return
             raise
@@ -155,10 +160,23 @@ class HttpReadStream(SeekStream):
                 pass
             self._resp = None
 
+    def _reconnect_pause(self, cause: Optional[BaseException]) -> None:
+        """Account one mid-body reconnect: raise past the no-progress
+        attempt cap or the policy's cumulative budget, else backoff."""
+        self._stalls += 1
+        if self._stalls >= self._policy.max_attempts:
+            err = Error(
+                f"read of {self.url} failed after {self._stalls} "
+                f"reconnects without progress at offset {self._pos}"
+            )
+            if cause is not None:
+                raise err from cause
+            raise err
+        self._policy.pause(cause=cause, what=f"read {self.url} @{self._pos}")
+
     def read(self, n: int = -1) -> bytes:
         if n == 0:
             return b""
-        retries = 3
         while True:
             if self._resp is None:
                 if self._size is not None and self._pos >= self._size:
@@ -166,16 +184,26 @@ class HttpReadStream(SeekStream):
                 self._restart()
                 if self._resp is None:
                     return b""
-            out = self._resp.read(None if n < 0 else n)
+            try:
+                out = self._resp.read(None if n < 0 else n)
+            except Exception as e:
+                # socket reset / IncompleteRead mid-body: resume the
+                # ranged GET at the exact offset instead of failing
+                self._drop()
+                if not is_transient(e):
+                    raise
+                self._reconnect_pause(e)
+                continue
             if out:
                 self._pos += len(out)
+                self._stalls = 0
                 return out
             self._drop()
             # empty read with bytes still expected = the server dropped the
             # connection mid-transfer; resume the ranged GET instead of
             # reporting a silently-truncated EOF
-            if self._size is not None and self._pos < self._size and retries:
-                retries -= 1
+            if self._size is not None and self._pos < self._size:
+                self._reconnect_pause(None)
                 continue
             return b""
 
@@ -503,8 +531,8 @@ class S3FileSystem(FileSystem):
         headers = self._signed_headers("HEAD", url, {}, b"")
         try:
             resp = _request(url, "HEAD", headers)
-        except Error as e:
-            if "HTTP 404" in str(e):
+        except HttpError as e:
+            if e.status == 404:
                 # maybe a "directory" (key prefix)
                 if self.list_directory(uri):
                     return FileInfo(uri.rstrip("/") + "/", 0, "directory")
@@ -562,6 +590,34 @@ class S3FileSystem(FileSystem):
         if b"<Error>" in out:
             raise Error(
                 f"DeleteObjects reported failures: {out[:500].decode(errors='replace')}"
+            )
+
+    # header name differs per store (GCS XML interop: x-goog-copy-source)
+    _COPY_SOURCE_HEADER = "x-amz-copy-source"
+
+    def copy(self, src_uri: str, dst_uri: str) -> None:
+        """Server-side object copy (PUT + copy-source header): no bytes
+        transit this process — the checkpoint tmp-key → final-key rename
+        costs one metadata round trip, not a re-upload."""
+        sbucket, skey = self.split_uri(src_uri)
+        dbucket, dkey = self.split_uri(dst_uri)
+        url = self.object_url(dbucket, dkey)
+        headers = {
+            self._COPY_SOURCE_HEADER: (
+                f"/{sbucket}/{urllib.parse.quote(skey, safe='/-_.~')}"
+            )
+        }
+        headers = self._signed_headers("PUT", url, headers, b"")
+        resp = _request(url, "PUT", headers)
+        try:
+            body = resp.read()
+        finally:
+            resp.close()
+        # S3 copy reports some failures inside a 200 body (API quirk)
+        if b"<Error>" in body:
+            raise Error(
+                f"copy {src_uri} -> {dst_uri} failed: "
+                f"{body[:300].decode(errors='replace')}"
             )
 
     def list_directory(self, uri: str) -> List[FileInfo]:
@@ -655,13 +711,21 @@ class OAuthTokenProvider:
             now = time.time()
             if self._token is not None and now < self._refresh_at:
                 return self._token
+            # the fetch runs under the lock, stalling every signing
+            # thread: with a still-valid cached token to fall back on,
+            # take ONE attempt (the early refresh retries on the next
+            # request anyway); only a token-less fetch earns the full
+            # retry schedule
+            have_fallback = self._token is not None and now < self._expiry
             try:
-                tok, ttl = self._fetch()
+                tok, ttl = self._fetch(
+                    RetryPolicy(max_attempts=1) if have_fallback else None
+                )
             except (OSError, Error, KeyError, ValueError):
                 # transient fetch failure: a still-valid token (we refresh
                 # _MARGIN early) must keep the job alive rather than
                 # downgrading a mid-run refresh hiccup into hard failure
-                if self._token is not None and now < self._expiry:
+                if have_fallback:
                     return self._token
                 raise
             ttl = max(float(ttl), 0.0)
@@ -675,7 +739,9 @@ class OAuthTokenProvider:
             self._expiry = now + ttl
             return self._token
 
-    def _fetch(self) -> Tuple[str, float]:
+    def _fetch(
+        self, policy: Optional[RetryPolicy] = None
+    ) -> Tuple[str, float]:
         raise NotImplementedError
 
 
@@ -693,9 +759,12 @@ class MetadataServerToken(OAuthTokenProvider):
             "service-accounts/default/token"
         )
 
-    def _fetch(self) -> Tuple[str, float]:
+    def _fetch(
+        self, policy: Optional[RetryPolicy] = None
+    ) -> Tuple[str, float]:
         resp = _request(
-            self.url, headers={"Metadata-Flavor": "Google"}, timeout=2.0
+            self.url, headers={"Metadata-Flavor": "Google"}, timeout=2.0,
+            policy=policy,
         )
         try:
             body = json.loads(resp.read())
@@ -764,7 +833,9 @@ class ServiceAccountToken(OAuthTokenProvider):
         sig = key.sign(signing_input, padding.PKCS1v15(), hashes.SHA256())
         return signing_input + b"." + self._b64(sig)
 
-    def _fetch(self) -> Tuple[str, float]:
+    def _fetch(
+        self, policy: Optional[RetryPolicy] = None
+    ) -> Tuple[str, float]:
         payload = urllib.parse.urlencode({
             "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
             "assertion": self._jwt(time.time()).decode(),
@@ -772,7 +843,7 @@ class ServiceAccountToken(OAuthTokenProvider):
         resp = _request(
             self.token_uri, "POST", {
                 "Content-Type": "application/x-www-form-urlencoded",
-            }, payload,
+            }, payload, policy=policy,
         )
         try:
             body = json.loads(resp.read())
@@ -838,6 +909,8 @@ class GCSFileSystem(S3FileSystem):
         """True while inside the post-failure probe backoff window."""
         return time.time() < self._probe_fail_until
 
+    _COPY_SOURCE_HEADER = "x-goog-copy-source"  # GCS XML interop spelling
+
     def _delete_batch(self, bucket: str, keys: List[str]) -> None:
         """GCS's XML interop API has no DeleteObjects POST — per-object
         DELETEs (the JSON batch API is a different protocol stack)."""
@@ -870,8 +943,73 @@ class GCSFileSystem(S3FileSystem):
 # -- WebHDFS -----------------------------------------------------------------
 
 
+class WebHdfsWriteStream(Stream):
+    """Buffered WebHDFS writer.
+
+    WebHDFS writes are a two-step dance: the namenode answers the
+    ``CREATE``/``APPEND`` operation with a 307 redirect naming the
+    datanode, and the payload goes to that Location (urllib refuses to
+    auto-follow redirects for PUT/POST, which is exactly right here —
+    the first request must carry no body). The first flushed part runs
+    ``CREATE`` (PUT), later parts ``APPEND`` (POST), so large files
+    stream in bounded memory. Part size via DMLC_WEBHDFS_WRITE_BUFFER_MB
+    (default 16; DMLC_WEBHDFS_WRITE_BUFFER_BYTES is the test hook).
+    """
+
+    def __init__(
+        self, fs: "WebHdfsFileSystem", uri: str, append: bool = False
+    ) -> None:
+        self.fs = fs
+        self.uri = uri
+        if "DMLC_WEBHDFS_WRITE_BUFFER_BYTES" in os.environ:  # test hook
+            self.part_bytes = int(os.environ["DMLC_WEBHDFS_WRITE_BUFFER_BYTES"])
+        else:
+            mb = int(os.environ.get("DMLC_WEBHDFS_WRITE_BUFFER_MB", "16"))
+            self.part_bytes = max(1, mb) << 20
+        self._buf = bytearray()
+        # append mode continues an existing file; a missing one is created
+        self._created = append and fs.exists(uri)
+        self._closed = False
+
+    def read(self, n: int = -1) -> bytes:
+        raise Error("WebHdfsWriteStream is write-only")
+
+    def write(self, data) -> int:
+        self._buf.extend(data)
+        while len(self._buf) >= self.part_bytes:
+            self._flush_part(bytes(self._buf[: self.part_bytes]))
+            del self._buf[: self.part_bytes]
+        return len(data)
+
+    def _flush_part(self, payload: bytes) -> None:
+        if not self._created:
+            # CREATE with overwrite=true is idempotent: a retried upload
+            # rewrites the same first part
+            url = self.fs._url(self.uri, "CREATE", overwrite="true")
+            self.fs._two_step(url, "PUT", payload)
+            self._created = True
+            return
+        # APPEND is NOT idempotent (a lost response after the commit
+        # would duplicate the part on retry) — fail loudly instead
+        url = self.fs._url(self.uri, "APPEND")
+        self.fs._two_step(url, "POST", payload, idempotent=False)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        # an empty buffer still CREATEs in 'w' mode (empty file lands)
+        if self._buf or not self._created:
+            self._flush_part(bytes(self._buf))
+            self._buf.clear()
+
+
 class WebHdfsFileSystem(FileSystem):
-    """hdfs:// via the WebHDFS REST API (op=OPEN/GETFILESTATUS/LISTSTATUS).
+    """hdfs:// via the WebHDFS REST API (op=OPEN/GETFILESTATUS/LISTSTATUS/
+    CREATE/APPEND/RENAME/DELETE).
 
     The reference wraps libhdfs over JNI (src/io/hdfs_filesys.cc); REST
     needs no JVM on the TPU host. Namenode HTTP port from
@@ -903,8 +1041,61 @@ class WebHdfsFileSystem(FileSystem):
         q = {"op": op, "user.name": self.user, **params}
         return base + urllib.parse.quote(path) + "?" + urllib.parse.urlencode(q)
 
+    def _two_step(
+        self,
+        op_url: str,
+        method: str,
+        payload: bytes,
+        idempotent: bool = True,
+    ) -> None:
+        """Namenode op → 307 Location → datanode payload upload. Also
+        accepts ``noredirect``-style servers that answer 200 with a JSON
+        ``Location`` instead of redirecting.
+
+        ``idempotent=False`` disables retry on the DATANODE leg (the
+        namenode leg carries no body and always retries): APPEND is not
+        idempotent — a response lost after the server committed the
+        bytes would duplicate the part on re-POST, silently corrupting
+        the file. Better the loud failure."""
+        location: Optional[str] = None
+        try:
+            resp = _request(op_url, method)
+        except HttpError as e:
+            if e.status not in (301, 302, 307):
+                raise
+            location = e.header("Location")
+            check(
+                bool(location),
+                f"webhdfs {method} redirect for {op_url} carries no Location",
+            )
+        else:
+            try:
+                body = resp.read()
+            finally:
+                resp.close()
+            if body:
+                try:
+                    location = json.loads(body).get("Location")
+                except ValueError:
+                    location = None
+            check(
+                bool(location),
+                f"webhdfs {method} {op_url}: expected a datanode redirect "
+                "or a JSON Location",
+            )
+        resp = _request(
+            location,  # type: ignore[arg-type]
+            method,
+            {"Content-Type": "application/octet-stream"},
+            payload,
+            policy=None if idempotent else RetryPolicy(max_attempts=1),
+        )
+        resp.close()
+
     def open(self, uri: str, mode: str = "r") -> Stream:
-        check(mode in ("r", "rb"), "webhdfs backend is read-only for now")
+        if mode in ("w", "wb", "a"):
+            return WebHdfsWriteStream(self, uri, append=(mode == "a"))
+        check(mode in ("r", "rb"), f"invalid webhdfs mode {mode!r}")
         info = self.get_path_info(uri)
 
         def prepare(pos: int, headers: Dict[str, str]):
@@ -914,6 +1105,27 @@ class WebHdfsFileSystem(FileSystem):
         return HttpReadStream(
             self._url(uri, "OPEN"), size=info.size, prepare=prepare
         )
+
+    def rename(self, src_uri: str, dst_uri: str) -> None:
+        """op=RENAME — atomic within HDFS (the namenode metadata swap),
+        which makes hdfs:// checkpoints genuinely atomic-rename like
+        local files. HDFS refuses to rename over an existing file, so a
+        present destination is deleted first (re-save into the same
+        step)."""
+        _, dst_path = self._base(dst_uri)
+        for attempt in range(2):
+            url = self._url(src_uri, "RENAME", destination=dst_path)
+            resp = _request(url, "PUT")
+            try:
+                ok = json.loads(resp.read() or b"{}").get("boolean", False)
+            finally:
+                resp.close()
+            if ok:
+                return
+            if attempt == 0 and self.exists(dst_uri):
+                self.delete(dst_uri)
+                continue
+            raise Error(f"webhdfs rename {src_uri} -> {dst_uri} refused")
 
     def get_path_info(self, uri: str) -> FileInfo:
         body = _read_all(self._url(uri, "GETFILESTATUS"))

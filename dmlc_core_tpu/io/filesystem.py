@@ -79,6 +79,34 @@ class FileSystem:
         """
         raise Error(f"{type(self).__name__} does not support delete")
 
+    def copy(self, src_uri: str, dst_uri: str) -> None:
+        """Copy one file/object within this filesystem. The default
+        streams the bytes through this process; object-store backends
+        override with a server-side copy (S3/GCS PUT + copy-source), so
+        the checkpoint tmp-key rename never re-uploads the payload."""
+        src = self.open(src_uri, "r")
+        try:
+            dst = self.open(dst_uri, "w")
+            try:
+                while True:
+                    chunk = src.read(1 << 20)
+                    if not chunk:
+                        break
+                    dst.write(chunk)
+            finally:
+                dst.close()
+        finally:
+            src.close()
+
+    def rename(self, src_uri: str, dst_uri: str) -> None:
+        """Move a file/object (crash-consistent commit primitive for
+        checkpoint._write_atomic's remote tmp-key path). Default is
+        copy-then-delete — NOT atomic, but ordered so a crash leaves
+        either no destination or a complete one, never a torn one;
+        backends with a real rename (WebHDFS op=RENAME) override."""
+        self.copy(src_uri, dst_uri)
+        self.delete(src_uri)
+
     def list_directory_recursive(self, uri: str) -> List[FileInfo]:
         """BFS expansion (reference ListDirectoryRecursive,
         src/io/filesys.cc:9-25)."""
@@ -100,9 +128,10 @@ class FileSystem:
         proto = URI(uri).protocol or "file://"
         entry = FS_REGISTRY.find(proto)
         if entry is None:
-            # any miss: load the cloud backends once and re-check, so
-            # cloudfs.py stays the single source of truth for protocols
-            from . import cloudfs  # noqa: F401 — registers cloud backends
+            # any miss: load the cloud backends (and the fault-injection
+            # wrapper) once and re-check, so cloudfs.py / faults.py stay
+            # the sources of truth for their protocols
+            from . import cloudfs, faults  # noqa: F401 — register backends
 
             entry = FS_REGISTRY.find(proto)
         if entry is None:

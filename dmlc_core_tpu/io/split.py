@@ -28,6 +28,7 @@ import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
 from ..utils.logging import Error, check, check_eq
+from . import retry as _retry
 from . import serializer
 from .filesystem import FileInfo, FileSystem
 from .recordio import (
@@ -167,6 +168,9 @@ class InputSplitBase(InputSplit):
         recurse_directories: bool = False,
     ) -> None:
         self.filesys = filesys or FileSystem.get_instance(uri.split(";")[0])
+        # retry/fault counters are process-global (io/retry.py); the
+        # snapshot makes io_stats() report this split's delta
+        self._retry_snap = _retry.stats()
         self._init_files(uri, recurse_directories)
         self.buffer_size = DEFAULT_BUFFER_BYTES
         self._fs: Optional[Stream] = None
@@ -372,6 +376,15 @@ class InputSplitBase(InputSplit):
             if chunk is None:
                 return None
             self._rec_iter = self.extract_records(chunk)
+
+    def io_stats(self) -> Dict[str, object]:
+        """Robustness counters since construction: transient-failure
+        ``retries`` healed, ``backoff_secs`` slept, ``faults_injected``
+        by a fault:// source. Counters are process-global deltas —
+        exact when one split is active, overlapping otherwise.
+        IndexedRecordIOSplitter extends this with its I/O-shape
+        counters (spans/seeks/bytes)."""
+        return {"mode": "sequential", **_retry.stats_delta(self._retry_snap)}
 
     def close(self) -> None:
         self._close_fs()
@@ -1065,7 +1078,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         """I/O-shape counters, cumulative since construction: ``spans``
         positioned reads issued, ``seeks`` stream seek() calls (0 on
         the local pread fast path), ``bytes_read``, and ``records`` —
-        records actually emitted (skip_records fast-forward excluded).
+        records actually emitted (skip_records fast-forward excluded) —
+        plus the robustness counters (``retries``/``backoff_secs``/
+        ``faults_injected`` deltas, see InputSplitBase.io_stats).
         Coalescing shows up as spans ≪ records."""
         seeks = self.seek_calls
         if self._span_reader is not None:
@@ -1076,6 +1091,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             "spans": self.spans_read,
             "seeks": seeks,
             "bytes_read": self.bytes_read,
+            **_retry.stats_delta(self._retry_snap),
         }
 
     def next_batch_ex(self, n_records: int) -> Optional[bytes]:
@@ -1386,6 +1402,10 @@ class CachedInputSplit(InputSplit):
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
 
+    def io_stats(self) -> Optional[Dict[str, object]]:
+        fn = getattr(self._base, "io_stats", None)
+        return fn() if fn is not None else None
+
     def close(self) -> None:
         self._iter.destroy()
         self._base.close()
@@ -1458,6 +1478,10 @@ class InputSplitShuffle(InputSplit):
 
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
+
+    def io_stats(self) -> Optional[Dict[str, object]]:
+        fn = getattr(self._base, "io_stats", None)
+        return fn() if fn is not None else None
 
     def close(self) -> None:
         self._base.close()

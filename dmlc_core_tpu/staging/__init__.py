@@ -16,7 +16,7 @@ from .fused import (
     dense_batches,
     ell_batches,
 )
-from .pipeline import StagingPipeline, stage_batch
+from .pipeline import StagingPipeline, drain_close, stage_batch
 
 __all__ = [
     "Batch",
@@ -30,6 +30,7 @@ __all__ = [
     "ShardedFusedBatches",
     "StagingPipeline",
     "dense_batches",
+    "drain_close",
     "ell_batches",
     "stage_batch",
 ]

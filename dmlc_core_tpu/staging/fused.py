@@ -582,8 +582,9 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         self.bad_records = 0
 
     def io_stats(self):
-        """Seek/span counters from the underlying split (indexed
-        shuffled reads), or None on the mmap/byte-sharded paths."""
+        """Counters from the underlying split — seek/span shape on
+        indexed shuffled reads, retry/fault deltas on every split-backed
+        path — or None on the mmap fast path."""
         fn = getattr(self._split, "io_stats", None)
         return fn() if fn is not None else None
 

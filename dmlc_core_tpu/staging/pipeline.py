@@ -22,6 +22,7 @@ network (SURVEY §5.8).
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, Iterable, Iterator, Optional
 
 import numpy as np
@@ -31,7 +32,9 @@ from ..utils.profiler import annotate
 from ..utils.timer import get_time
 from .batcher import Batch
 
-__all__ = ["StagingPipeline", "stage_batch"]
+__all__ = ["StagingPipeline", "drain_close", "stage_batch"]
+
+logger = logging.getLogger("dmlc_core_tpu.staging")
 
 
 def _require_jax():
@@ -182,6 +185,7 @@ class StagingPipeline:
         prefetch: int = 2,
     ) -> None:
         self._jax = _require_jax()
+        self._source = host_batches
         self._device = device
         self._mesh = mesh
         self._data_axis = data_axis
@@ -332,3 +336,37 @@ class StagingPipeline:
         if not (host_joined and xfer_joined):
             self.close_timed_out = True
         return host_joined and xfer_joined
+
+    def io_stats(self) -> Optional[Dict[str, Any]]:
+        """Forward the batch source's counters (split I/O shape +
+        retry/fault deltas) — the last hop of the io_stats plumbing
+        (split → fused staging → pipeline → bench)."""
+        fn = getattr(self._source, "io_stats", None)
+        return fn() if fn is not None else None
+
+
+def drain_close(pipe: StagingPipeline, *sources) -> bool:
+    """Close a StagingPipeline, then its batch source(s) — honoring
+    ``close_timed_out``.
+
+    When the bounded teardown join timed out, an orphaned producer
+    thread may still be reading the sources' buffers (mmap windows,
+    fused ring slots); ``source.close()`` here would unmap them under a
+    live reader. Instead the sources are deliberately leaked: the
+    daemon thread exits at its next queue put and the mappings fall to
+    GC/process teardown. Returns True when everything closed cleanly.
+    """
+    clean = pipe.close()
+    if not clean:
+        logger.warning(
+            "staging teardown join timed out; deferring close of %d "
+            "batch source(s) to process teardown (orphaned producer "
+            "thread may still be reading their buffers)",
+            len(sources),
+        )
+        return False
+    for s in sources:
+        close = getattr(s, "close", None)
+        if close is not None:
+            close()
+    return True

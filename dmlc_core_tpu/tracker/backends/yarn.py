@@ -33,10 +33,10 @@ import shlex
 import subprocess
 import threading
 import time
-import urllib.error
-import urllib.request
 from typing import Dict, List, Optional
 
+from ...io import retry as _retry
+from ...utils.logging import Error
 from ..opts import get_cache_file_set
 from . import run_tracker_submit
 
@@ -90,21 +90,26 @@ class YarnRestClient:
     ) -> dict:
         url = f"{self.endpoint}{path}"
         data = None if payload is None else json.dumps(payload).encode()
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/json")
+        headers = {"Content-Type": "application/json"} if data else {}
+        # the shared transient-failure retry layer (io/retry.py): a
+        # restarting RM costs a backoff, not the submission
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                body = resp.read()
-        except urllib.error.HTTPError as exc:
-            detail = exc.read()[:300].decode(errors="replace")
+            resp = _retry.request(
+                url, method, headers, data, timeout=self.timeout
+            )
+        except _retry.HttpError as exc:
+            detail = str(exc).split(": ", 1)[-1][:300]
             raise RuntimeError(
-                f"YARN RM {method} {path} failed: HTTP {exc.code} {detail}"
+                f"YARN RM {method} {path} failed: HTTP {exc.status} {detail}"
             ) from None
-        except urllib.error.URLError as exc:
+        except Error as exc:
             raise RuntimeError(
-                f"YARN RM unreachable at {self.endpoint}: {exc.reason}"
+                f"YARN RM unreachable at {self.endpoint}: {exc}"
             ) from None
+        try:
+            body = resp.read()
+        finally:
+            resp.close()
         return json.loads(body) if body.strip() else {}
 
     def new_application(self) -> dict:

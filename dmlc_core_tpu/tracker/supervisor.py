@@ -1,4 +1,5 @@
-"""Cluster-level fault tolerance: supervised relaunch with blacklisting.
+"""Cluster-level fault tolerance: supervised relaunch with backoff,
+host quarantine and blacklisting.
 
 The capability the reference implements only inside its Java YARN
 ApplicationMaster (reference
@@ -10,14 +11,26 @@ kubernetes launchers share, so every cluster gets the same semantics:
 
 - each task gets at most ``max_attempt`` total runs; one more failure
   aborts the whole job (all still-running tasks are killed);
-- a host that accumulates ``host_fail_limit`` failures is blacklisted and
-  its tasks move to healthy hosts (when the backend allows re-placement —
-  TPU pods pin task i to pod host i, so for them a blacklisted host means
-  abort, documented divergence);
+- relaunches are spaced by EXPONENTIAL BACKOFF (the io/retry.py policy
+  applied at the cluster layer): attempt k waits
+  ``min(backoff_cap, relaunch_backoff * 2**(k-1))`` — a crash-looping
+  task must not hammer the tracker/filesystem at poll speed;
+- a host where a task just died is QUARANTINED for
+  ``quarantine_secs * 2**(fails-1)`` (capped): its next placement
+  prefers another healthy host instead of the immediate same-host
+  retry, but a sole surviving host is still used (liveness beats
+  placement hygiene). A host that accumulates ``host_fail_limit``
+  failures is blacklisted outright and its tasks move to healthy hosts
+  (when the backend allows re-placement — TPU pods pin task i to pod
+  host i, so for them a blacklisted host means abort, documented
+  divergence);
 - every (re)launch exports ``DMLC_NUM_ATTEMPT`` (the attempt index, same
   env the reference local launcher uses, reference local.py:26-49), so a
   restarted worker can reconnect with ``cmd='recover'`` and the tracker
   re-issues its previous rank (tracker.py recover path, SURVEY §5.3).
+
+Env knobs: DMLC_MAX_ATTEMPT (3), DMLC_RELAUNCH_BACKOFF (1.0s base),
+DMLC_HOST_QUARANTINE (5.0s base).
 """
 
 from __future__ import annotations
@@ -27,7 +40,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Supervisor", "JobAborted", "default_max_attempt"]
 
@@ -47,6 +60,13 @@ def default_max_attempt(fallback: int = 3) -> int:
         return max(1, fallback)
 
 
+def _env_secs(name: str, fallback: float) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, fallback)))
+    except ValueError:
+        return fallback
+
+
 @dataclass
 class _Running:
     task_id: int
@@ -60,8 +80,9 @@ class Supervisor:
 
     ``launch(task_id, host, attempt)`` must start the task and return a
     Popen-like handle (``poll() -> Optional[int]``, ``kill()``,
-    ``wait()``). The supervisor owns placement, retry budgets, and the
-    blacklist; backends own command construction.
+    ``wait()``). The supervisor owns placement, retry budgets, backoff
+    pacing, and the quarantine/blacklist; backends own command
+    construction.
     """
 
     def __init__(
@@ -72,6 +93,9 @@ class Supervisor:
         host_fail_limit: Optional[int] = None,
         allow_replacement: bool = True,
         poll_interval: float = 0.05,
+        relaunch_backoff: Optional[float] = None,
+        backoff_cap: float = 30.0,
+        quarantine_secs: Optional[float] = None,
     ) -> None:
         self.launch = launch
         self.hosts = list(hosts)
@@ -90,55 +114,117 @@ class Supervisor:
         self.allow_replacement = allow_replacement
         self._thread: Optional[threading.Thread] = None
         self.poll_interval = poll_interval
+        # exponential relaunch backoff: attempt k sleeps
+        # min(cap, base * 2**(k-1)); 0 restores immediate relaunch
+        self.relaunch_backoff = (
+            relaunch_backoff
+            if relaunch_backoff is not None
+            else _env_secs("DMLC_RELAUNCH_BACKOFF", 1.0)
+        )
+        self.backoff_cap = backoff_cap
+        # per-failure host quarantine, doubling per repeat offense
+        self.quarantine_secs = (
+            quarantine_secs
+            if quarantine_secs is not None
+            else _env_secs("DMLC_HOST_QUARANTINE", 5.0)
+        )
         self.failures: Dict[int, int] = {}  # task_id -> failed runs
         self.host_failures: Dict[str, int] = {}
         self.blacklist: set = set()
+        self.quarantined: Dict[str, float] = {}  # host -> release monotonic
         self.placement: Dict[int, str] = {}
         self.relaunches = 0
+        self.backoffs: List[float] = []  # scheduled relaunch delays
         self.error: Optional[BaseException] = None
 
     # -- placement -----------------------------------------------------------
     def _healthy_hosts(self) -> List[str]:
         return [h for h in self.hosts if h not in self.blacklist]
 
+    def _quarantine(self, host: str) -> None:
+        """Exclude a just-failed host from NEW placements for a while,
+        doubling per repeat offense (capped at 16x the base)."""
+        if self.quarantine_secs <= 0:
+            return
+        fails = self.host_failures.get(host, 1)
+        hold = self.quarantine_secs * min(16.0, 2.0 ** (fails - 1))
+        self.quarantined[host] = max(
+            self.quarantined.get(host, 0.0), time.monotonic() + hold
+        )
+        logger.info("quarantining host %s for %.1fs (%d failures)",
+                    host, hold, fails)
+
     def _pick_host(self, task_id: int, prev: Optional[str]) -> str:
         healthy = self._healthy_hosts()
-        if prev is not None and prev in healthy:
-            return prev
-        if prev is not None and not self.allow_replacement:
+        if prev is not None and prev not in healthy and not self.allow_replacement:
             raise JobAborted(
                 f"host {prev!r} is blacklisted and task {task_id} cannot "
                 "be re-placed on this backend"
             )
         if not healthy:
             raise JobAborted("every host is blacklisted")
-        return healthy[task_id % len(healthy)]
+        now = time.monotonic()
+        calm = [h for h in healthy if self.quarantined.get(h, 0.0) <= now]
+        if prev is not None:
+            if prev in calm:
+                return prev
+            if prev in healthy and (not self.allow_replacement or not calm):
+                # pinned placement (quarantine cannot move the task — the
+                # relaunch backoff is the only pacing) or every healthy
+                # host quarantined: liveness beats placement hygiene
+                return prev
+        # a quarantined prev never reaches this point with calm hosts
+        # available (the branches above returned otherwise), so indexing
+        # into calm IS the "no immediate same-host retry" rule
+        pool = calm or healthy
+        return pool[task_id % len(pool)]
 
     # -- failure accounting (reference handleFailure) ------------------------
-    def _handle_failure(self, r: _Running, returncode: int) -> _Running:
+    def _handle_failure(
+        self, r: _Running, returncode: int
+    ) -> Tuple[float, _Running]:
+        """Account one failure; returns ``(ready_at, pending)`` — the
+        relaunch is SCHEDULED (exponential backoff), not launched, so a
+        crash-looping task cannot hammer the cluster at poll speed."""
         self.failures[r.task_id] = self.failures.get(r.task_id, 0) + 1
         self.host_failures[r.host] = self.host_failures.get(r.host, 0) + 1
+        self._quarantine(r.host)
         if self.host_failures[r.host] >= self.host_fail_limit:
             if r.host not in self.blacklist:
                 logger.warning("blacklisting host %s", r.host)
             self.blacklist.add(r.host)
-        if self.failures[r.task_id] >= self.max_attempt:
+        nfail = self.failures[r.task_id]
+        if nfail >= self.max_attempt:
             raise JobAborted(
-                f"task {r.task_id} failed {self.failures[r.task_id]} times "
+                f"task {r.task_id} failed {nfail} times "
                 f"(returncode={returncode}, max_attempt={self.max_attempt})"
             )
-        # pass the previous host as-is: _pick_host keeps it when healthy,
-        # re-places when blacklisted, and aborts when the backend pins
-        # placement (allow_replacement=False)
-        host = self._pick_host(r.task_id, r.host)
-        attempt = self.failures[r.task_id]
-        logger.info(
-            "task %d failed on %s (ret=%d); relaunch attempt %d on %s",
-            r.task_id, r.host, returncode, attempt, host,
+        delay = (
+            min(self.backoff_cap, self.relaunch_backoff * (2.0 ** (nfail - 1)))
+            if self.relaunch_backoff > 0
+            else 0.0
         )
+        self.backoffs.append(delay)
+        logger.info(
+            "task %d failed on %s (ret=%d); relaunch attempt %d in %.1fs",
+            r.task_id, r.host, returncode, nfail, delay,
+        )
+        return time.monotonic() + delay, _Running(r.task_id, r.host, nfail, None)
+
+    def _relaunch(self, pending: _Running) -> _Running:
+        """Launch a scheduled relaunch NOW; the host is picked at launch
+        time so quarantine/blacklist state is current."""
+        host = self._pick_host(pending.task_id, pending.host)
         self.relaunches += 1
-        self.placement[r.task_id] = host
-        return _Running(r.task_id, host, attempt, self.launch(r.task_id, host, attempt))
+        self.placement[pending.task_id] = host
+        logger.info(
+            "relaunching task %d attempt %d on %s",
+            pending.task_id, pending.attempt, host,
+        )
+        return _Running(
+            pending.task_id, host, pending.attempt,
+            self.launch(pending.task_id, host, pending.attempt),
+        )
 
     # -- main loop -----------------------------------------------------------
     def run(self, n_tasks: int) -> None:
@@ -147,26 +233,37 @@ class Supervisor:
         also recorded on ``self.error`` for callers running this on a
         thread."""
         running: Dict[int, _Running] = {}
+        deferred: List[Tuple[float, _Running]] = []  # (ready_at, pending)
         try:
             for tid in range(n_tasks):
                 host = self._pick_host(tid, None)
                 self.placement[tid] = host
                 running[tid] = _Running(tid, host, 0, self.launch(tid, host, 0))
-            while running:
+            while running or deferred:
+                now = time.monotonic()
+                due = [p for t, p in deferred if t <= now]
+                deferred = [(t, p) for t, p in deferred if t > now]
+                for pending in due:
+                    running[pending.task_id] = self._relaunch(pending)
                 finished = [
                     (tid, r.proc.poll())
                     for tid, r in running.items()
                     if r.proc.poll() is not None
                 ]
                 if not finished:
-                    time.sleep(self.poll_interval)
+                    wait = self.poll_interval
+                    if not running and deferred:
+                        # nothing to poll: sleep straight to the
+                        # earliest scheduled relaunch
+                        wait = max(0.0, min(t for t, _ in deferred) - now)
+                    time.sleep(wait)
                     continue
                 for tid, ret in finished:
                     r = running.pop(tid)
                     if ret == 0:
                         logger.debug("task %d finished", tid)
                         continue
-                    running[tid] = self._handle_failure(r, int(ret))
+                    deferred.append(self._handle_failure(r, int(ret)))
         except BaseException as e:
             self.error = e
             for r in running.values():

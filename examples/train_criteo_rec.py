@@ -79,7 +79,12 @@ def main() -> None:
 
     from dmlc_core_tpu.checkpoint import Checkpointer
     from dmlc_core_tpu.models import FactorizationMachine
-    from dmlc_core_tpu.staging import BatchSpec, StagingPipeline, ell_batches
+    from dmlc_core_tpu.staging import (
+        BatchSpec,
+        StagingPipeline,
+        drain_close,
+        ell_batches,
+    )
 
     path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/criteo_demo.rec"
     if not os.path.exists(path):
@@ -198,8 +203,8 @@ def main() -> None:
             f"({stats['rows_per_sec']:,.0f} rows/s, "
             f"{stats['mb_per_sec']:,.0f} MB/s into device)"
         )
-        stream.close()
-        pipe.close()
+        # pipeline first, source second, honoring close_timed_out
+        drain_close(pipe, stream)
         # epoch boundary: next resume starts the following epoch clean.
         # async: the write overlaps the next epoch's training; ck.save/
         # restore/wait all drain it, and the final wait() below surfaces

@@ -34,7 +34,12 @@ def main() -> None:
     from dmlc_core_tpu import data as D
     from dmlc_core_tpu.checkpoint import Checkpointer
     from dmlc_core_tpu.models import LogisticRegression
-    from dmlc_core_tpu.staging import BatchSpec, FixedShapeBatcher, StagingPipeline
+    from dmlc_core_tpu.staging import (
+        BatchSpec,
+        FixedShapeBatcher,
+        StagingPipeline,
+        drain_close,
+    )
 
     path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/higgs_demo.libsvm"
     if not os.path.exists(path):
@@ -65,8 +70,10 @@ def main() -> None:
             f"rank {rank} epoch {epoch}: loss={loss_str} "
             f"({stats['rows_per_sec']:,.0f} rows/s into device)"
         )
-        parser.close()
-        pipe.close()
+        # pipeline first, source second — and only when the teardown
+        # join completed (close_timed_out): an orphaned producer thread
+        # may still be reading the parser's buffers
+        drain_close(pipe, parser)
         ck.save(epoch, params)
     print("latest checkpoint step:", ck.latest_step())
 

@@ -98,7 +98,10 @@ class RangeFileHandler(BaseHTTPRequestHandler):
 
 class FakeS3Handler(BaseHTTPRequestHandler):
     """Minimal S3: path-style /bucket/key; GET/HEAD/PUT objects with Range,
-    ListObjectsV2, multipart upload. Asserts SigV4 Authorization headers."""
+    ListObjectsV2, multipart upload, server-side copy. Asserts SigV4
+    Authorization headers. FAIL_GET / FAIL_PART_PUT script N consecutive
+    500s before success (the transient-failure shapes the retry layer
+    must heal)."""
 
     STORE = {}
     UPLOADS = {}
@@ -107,6 +110,8 @@ class FakeS3Handler(BaseHTTPRequestHandler):
     ACCESS = "AKIDTEST"
     SECRET = "sekrit"
     REGION = "us-east-1"
+    FAIL_GET = 0
+    FAIL_PART_PUT = 0
 
     def log_message(self, *a):
         pass
@@ -164,6 +169,10 @@ class FakeS3Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if type(self).FAIL_GET > 0:
+            type(self).FAIL_GET -= 1
+            self.send_error(500, "InternalError (scripted)")
+            return
         if not self._check_auth():
             return
         key, q = self._key()
@@ -229,6 +238,10 @@ class FakeS3Handler(BaseHTTPRequestHandler):
         key, q = self._key()
         body = self._body()
         if "partNumber" in q:
+            if type(self).FAIL_PART_PUT > 0:
+                type(self).FAIL_PART_PUT -= 1
+                self.send_error(500, "InternalError (scripted)")
+                return
             uid = q["uploadId"][0]
             pn = int(q["partNumber"][0])
             self.UPLOADS.setdefault(uid, {})[pn] = body
@@ -237,6 +250,19 @@ class FakeS3Handler(BaseHTTPRequestHandler):
             self.send_header("ETag", etag)
             self.send_header("Content-Length", "0")
             self.end_headers()
+            return
+        src = self.headers.get("x-amz-copy-source")
+        if src:
+            src_key = urllib.parse.unquote(src).lstrip("/")
+            if src_key not in self.STORE:
+                self.send_error(404)
+                return
+            self.STORE[key] = self.STORE[src_key]
+            out = b"<CopyObjectResult/>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
             return
         self.STORE[key] = body
         self.send_response(200)
@@ -305,10 +331,96 @@ class FakeS3Handler(BaseHTTPRequestHandler):
 
 
 class FakeWebHdfsHandler(BaseHTTPRequestHandler):
+    """Read ops plus the write surface: CREATE/APPEND answer the
+    namenode request with a 307 redirect to a fake 'datanode' path on
+    the same server (the real WebHDFS two-step), RENAME moves keys,
+    DELETE removes them."""
+
     FILES = {"/data/a.txt": b"alpha\nbeta\ngamma\n"}
+    _DN = "/webhdfs/dn/v1"  # fake datanode prefix
 
     def log_message(self, *a):
         pass
+
+    def _parsed(self):
+        parsed = urllib.parse.urlsplit(self.path)
+        q = urllib.parse.parse_qs(parsed.query)
+        return parsed.path, q
+
+    def _redirect_to_dn(self, path, q):
+        loc = (
+            f"http://{self.headers['Host']}{self._DN}{path}"
+            + "?" + urllib.parse.urlencode({k: v[0] for k, v in q.items()})
+        )
+        self.send_response(307)
+        self.send_header("Location", loc)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_PUT(self):
+        path, q = self._parsed()
+        op = q.get("op", [""])[0]
+        if path.startswith(self._DN):
+            # datanode leg: the payload lands
+            n = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(n)
+            hpath = path[len(self._DN):]
+            if op == "CREATE":
+                self.FILES[hpath] = data
+                self._json({}, code=201)
+            else:
+                self.send_error(400, f"bad datanode op {op}")
+            return
+        assert path.startswith("/webhdfs/v1")
+        hpath = path[len("/webhdfs/v1"):]
+        if op == "CREATE":
+            self._redirect_to_dn(hpath, q)
+            return
+        if op == "RENAME":
+            dst = q["destination"][0]
+            if hpath not in self.FILES:
+                self._json({"boolean": False})
+                return
+            if dst in self.FILES:
+                # HDFS refuses to rename over an existing file
+                self._json({"boolean": False})
+                return
+            self.FILES[dst] = self.FILES.pop(hpath)
+            self._json({"boolean": True})
+            return
+        self.send_error(400, f"bad PUT op {op}")
+
+    def do_POST(self):
+        path, q = self._parsed()
+        op = q.get("op", [""])[0]
+        if path.startswith(self._DN):
+            n = int(self.headers.get("Content-Length") or 0)
+            data = self.rfile.read(n)
+            hpath = path[len(self._DN):]
+            if op == "APPEND":
+                self.FILES[hpath] = self.FILES.get(hpath, b"") + data
+                self._json({})
+            else:
+                self.send_error(400, f"bad datanode op {op}")
+            return
+        assert path.startswith("/webhdfs/v1")
+        if op == "APPEND":
+            self._redirect_to_dn(path[len("/webhdfs/v1"):], q)
+            return
+        self.send_error(400, f"bad POST op {op}")
+
+    def do_DELETE(self):
+        path, q = self._parsed()
+        assert path.startswith("/webhdfs/v1")
+        hpath = path[len("/webhdfs/v1"):]
+        self._json({"boolean": self.FILES.pop(hpath, None) is not None})
 
     def do_GET(self):
         parsed = urllib.parse.urlsplit(self.path)
@@ -421,11 +533,16 @@ def s3(monkeypatch):
     FakeS3Handler.STORE = {}
     FakeS3Handler.UPLOADS = {}
     FakeS3Handler.SAW_AUTH = []
+    FakeS3Handler.FAIL_GET = 0
+    FakeS3Handler.FAIL_PART_PUT = 0
     srv = _Server(FakeS3Handler)
     monkeypatch.setenv("S3_ENDPOINT", srv.url)
     monkeypatch.setenv("AWS_ACCESS_KEY_ID", "AKIDTEST")
     monkeypatch.setenv("AWS_SECRET_ACCESS_KEY", "sekrit")
     monkeypatch.setenv("AWS_REGION", "us-east-1")
+    # retry backoff at test speed (policies read env at construction)
+    monkeypatch.setenv("DMLC_RETRY_BASE_SECS", "0.001")
+    monkeypatch.setenv("DMLC_RETRY_CAP_SECS", "0.01")
     reset_singletons()
     yield srv
     reset_singletons()
@@ -731,6 +848,18 @@ def test_gcs_adc_checkpoint_lifecycle(gcs_adc, monkeypatch):
     def do_PUT(self):
         if not self._authed():
             return
+        src = self.headers.get("x-goog-copy-source")
+        if src:
+            # server-side copy: the checkpoint tmp-key commit path
+            store[self._key()] = store[
+                urllib.parse.unquote(src).lstrip("/")
+            ]
+            out = b"<CopyObjectResult/>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+            return
         n = int(self.headers.get("Content-Length", "0"))
         store[self._key()] = self.rfile.read(n)
         self.send_response(200)
@@ -909,6 +1038,7 @@ def test_gcs_service_account_jwt(tmp_path, monkeypatch):
 
 @pytest.fixture
 def webhdfs(monkeypatch):
+    FakeWebHdfsHandler.FILES = {"/data/a.txt": b"alpha\nbeta\ngamma\n"}
     srv = _Server(FakeWebHdfsHandler)
     monkeypatch.setenv("DMLC_WEBHDFS_PORT", str(srv.port))
     reset_singletons()
@@ -941,4 +1071,132 @@ def test_s3_key_with_special_chars(s3):
     w.close()
     r = fs.open(key_uri, "r")
     assert r.read() == b"special"
+
+
+# -- transient-failure retry against the fake servers -------------------------
+
+
+def test_s3_get_heals_consecutive_5xx(s3):
+    """Acceptance: a 3-consecutive-5xx S3 GET succeeds via retry, with
+    the healed retries visible in the global counters."""
+    from dmlc_core_tpu.io import retry
+
+    payload = bytes(range(256)) * 8
+    FakeS3Handler.STORE["bkt/flaky.bin"] = payload
+    fs = FileSystem.get_instance("s3://bkt/flaky.bin")
+    before = retry.stats()
+    FakeS3Handler.FAIL_GET = 3
+    r = fs.open("s3://bkt/flaky.bin", "r")
+    assert r.read() == payload
     r.close()
+    delta = retry.stats_delta(before)
+    assert delta["retries"] >= 3
+    assert delta["backoff_secs"] > 0
+
+
+def test_s3_retry_exhaustion_reraises_last_error(s3, monkeypatch):
+    """Past the attempt cap the LAST error surfaces (an HTTP 500 here),
+    not a generic retry wrapper message."""
+    from dmlc_core_tpu.io.retry import HttpError
+
+    monkeypatch.setenv("DMLC_RETRY_ATTEMPTS", "3")
+    FakeS3Handler.STORE["bkt/dead.bin"] = b"x"
+    fs = FileSystem.get_instance("s3://bkt/dead.bin")
+    FakeS3Handler.FAIL_GET = 50  # more than any budget
+    with pytest.raises(HttpError, match="HTTP 500") as ei:
+        r = fs.open("s3://bkt/dead.bin", "r")
+        r.read()
+    assert ei.value.status == 500
+    assert FakeS3Handler.FAIL_GET >= 40, "attempt cap did not bound retries"
+
+
+def test_s3_multipart_failed_part_retries_that_part(s3, monkeypatch):
+    """Acceptance: a failed multipart part upload re-uploads THE PART
+    (same partNumber) and the completed object is byte-identical."""
+    from dmlc_core_tpu.io import retry
+
+    monkeypatch.setenv("DMLC_S3_WRITE_BUFFER_BYTES", "1024")
+    reset_singletons()
+    fs = FileSystem.get_instance("s3://bkt/big2.bin")
+    payload = os.urandom(5000)
+    before = retry.stats()
+    FakeS3Handler.FAIL_PART_PUT = 2
+    w = fs.open("s3://bkt/big2.bin", "w")
+    w.write(payload)
+    w.close()
+    assert FakeS3Handler.STORE["bkt/big2.bin"] == payload
+    assert retry.stats_delta(before)["retries"] >= 2
+
+
+def test_s3_server_side_copy(s3):
+    FakeS3Handler.STORE["bkt/src key.bin"] = b"copy-me"
+    fs = FileSystem.get_instance("s3://bkt/x")
+    fs.copy("s3://bkt/src key.bin", "s3://bkt/dst.bin")
+    assert FakeS3Handler.STORE["bkt/dst.bin"] == b"copy-me"
+
+
+def test_s3_atomic_checkpoint_write(s3):
+    """checkpoint._write_atomic on a remote URI: tmp key + length verify
+    + server-side rename; no .tmp debris after a clean commit."""
+    import numpy as np
+
+    from dmlc_core_tpu.checkpoint import _write_atomic, load_pytree
+
+    tree = {"w": np.arange(16, dtype=np.float32)}
+    _write_atomic("s3://bkt/ck/model.bin", tree)
+    assert "bkt/ck/model.bin" in FakeS3Handler.STORE
+    assert "bkt/ck/model.bin.tmp" not in FakeS3Handler.STORE
+    out = load_pytree("s3://bkt/ck/model.bin")
+    np.testing.assert_array_equal(out["w"], tree["w"])
+
+
+# -- webhdfs writes -----------------------------------------------------------
+
+
+def test_webhdfs_write_roundtrip(webhdfs, monkeypatch):
+    """The two-step CREATE redirect → datanode PUT, then APPEND parts:
+    hdfs:// is no longer read-only (the reference backend writes)."""
+    monkeypatch.setenv("DMLC_WEBHDFS_WRITE_BUFFER_BYTES", "1024")
+    payload = bytes(range(256)) * 10  # 2560 bytes -> CREATE + 2 APPENDs
+    w = Stream.create("hdfs://127.0.0.1:8020/data/out.bin", "w")
+    w.write(payload)
+    w.close()
+    assert FakeWebHdfsHandler.FILES["/data/out.bin"] == payload
+    r = Stream.create("hdfs://127.0.0.1:8020/data/out.bin", "r")
+    assert r.read() == payload
+    r.close()
+
+
+def test_webhdfs_write_empty_file_lands(webhdfs):
+    w = Stream.create("hdfs://127.0.0.1:8020/data/empty.bin", "w")
+    w.close()
+    assert FakeWebHdfsHandler.FILES["/data/empty.bin"] == b""
+
+
+def test_webhdfs_rename_and_atomic_checkpoint(webhdfs):
+    import numpy as np
+
+    from dmlc_core_tpu.checkpoint import _write_atomic, load_pytree
+
+    fs = FileSystem.get_instance("hdfs://127.0.0.1:8020/x")
+    FakeWebHdfsHandler.FILES["/data/a2.txt"] = b"move-me"
+    fs.rename(
+        "hdfs://127.0.0.1:8020/data/a2.txt",
+        "hdfs://127.0.0.1:8020/data/b2.txt",
+    )
+    assert "/data/a2.txt" not in FakeWebHdfsHandler.FILES
+    assert FakeWebHdfsHandler.FILES["/data/b2.txt"] == b"move-me"
+    # rename over an existing destination deletes it first (re-save)
+    FakeWebHdfsHandler.FILES["/data/c2.txt"] = b"old"
+    FakeWebHdfsHandler.FILES["/data/b3.txt"] = b"new"
+    fs.rename(
+        "hdfs://127.0.0.1:8020/data/b3.txt",
+        "hdfs://127.0.0.1:8020/data/c2.txt",
+    )
+    assert FakeWebHdfsHandler.FILES["/data/c2.txt"] == b"new"
+    tree = {"w": np.full(8, 3, dtype=np.int64)}
+    _write_atomic("hdfs://127.0.0.1:8020/ck/model.bin", tree)
+    assert "/ck/model.bin" in FakeWebHdfsHandler.FILES
+    assert "/ck/model.bin.tmp" not in FakeWebHdfsHandler.FILES
+    out = load_pytree("hdfs://127.0.0.1:8020/ck/model.bin")
+    np.testing.assert_array_equal(out["w"], tree["w"])

@@ -78,6 +78,30 @@ def test_duplicate_dict_key_flagged(tmp_path):
     assert codes("d = {'a': 1, 'b': 2}\n", tmp_path) == []
 
 
+def test_direct_urlopen_flagged(tmp_path):
+    src = "import urllib.request\nurllib.request.urlopen('http://x')\n"
+    assert codes(src, tmp_path) == ["L006"]
+    src = (
+        "from urllib.request import urlopen\nurlopen('http://x')\n"
+    )
+    assert codes(src, tmp_path) == ["L006"]
+    # an alias does not dodge the rule
+    src = (
+        "from urllib.request import urlopen as uo\nuo('http://x')\n"
+    )
+    assert codes(src, tmp_path) == ["L006"]
+
+
+def test_urlopen_quiet_in_retry_layer(tmp_path):
+    """io/retry.py owns the single urlopen call site and is exempt."""
+    d = tmp_path / "io"
+    d.mkdir()
+    src = "import urllib.request\nurllib.request.urlopen('http://x')\n"
+    f = d / "retry.py"
+    f.write_text(src)
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

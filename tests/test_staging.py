@@ -344,3 +344,59 @@ def test_pipeline_close_does_not_wedge_on_stalled_producer():
     assert pipe.close_timed_out is True
     # the abandoned iterator must also see a clean end, not a hang
     assert list(it) == []
+
+
+class _ClosableSource:
+    """Iterable batch source recording whether close() was called."""
+
+    def __init__(self, batches):
+        self._batches = batches
+        self.closed = False
+
+    def __iter__(self):
+        return iter(self._batches)
+
+    def close(self):
+        self.closed = True
+
+
+@pytest.mark.jax
+def test_drain_close_closes_source_on_clean_join():
+    from dmlc_core_tpu.staging import drain_close
+
+    spec = BatchSpec(batch_size=2, layout="ell", max_nnz=3)
+    b = FixedShapeBatcher(spec)
+    src = _ClosableSource(list(b.push(ragged_block([1, 2]))))
+    pipe = StagingPipeline(src)
+    for _ in pipe:
+        pass
+    assert drain_close(pipe, src) is True
+    assert src.closed is True
+
+
+@pytest.mark.jax
+def test_drain_close_defers_source_on_timed_out_join():
+    """close_timed_out honored: an orphaned producer thread may still be
+    reading the source's (mmap-backed) buffers — drain_close must NOT
+    free them under it."""
+    import time
+
+    spec = BatchSpec(batch_size=2, layout="ell", max_nnz=3)
+
+    class _StalledSource(_ClosableSource):
+        def __iter__(self):
+            b = FixedShapeBatcher(spec)
+            yield from b.push(ragged_block([1, 2]))
+            time.sleep(30)  # un-interruptible upstream stall
+            yield from b.push(ragged_block([1, 2]))  # pragma: no cover
+
+    from dmlc_core_tpu.staging import drain_close
+
+    src = _StalledSource([])
+    pipe = StagingPipeline(src)
+    it = iter(pipe)
+    next(it)
+    time.sleep(0.2)  # let the producer enter the stall
+    assert drain_close(pipe, src) is False
+    assert pipe.close_timed_out is True
+    assert src.closed is False, "source freed under a live reader thread"

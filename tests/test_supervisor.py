@@ -59,7 +59,8 @@ def test_relaunch_until_success():
             return FakeProc(1)
         return FakeProc(0)
 
-    sup = Supervisor(launch, hosts=["h0"], max_attempt=3, poll_interval=0)
+    sup = Supervisor(launch, hosts=["h0"], max_attempt=3, poll_interval=0,
+                     relaunch_backoff=0)
     sup.run(2)
     assert sup.relaunches == 2
     assert sup.failures == {1: 2}
@@ -76,7 +77,8 @@ def test_abort_past_budget_kills_survivors():
     def launch(task_id, host, attempt):
         return FakeProc(1) if task_id == 0 else hang
 
-    sup = Supervisor(launch, hosts=["h0"], max_attempt=2, poll_interval=0)
+    sup = Supervisor(launch, hosts=["h0"], max_attempt=2, poll_interval=0,
+                     relaunch_backoff=0)
     with pytest.raises(JobAborted, match="task 0 failed 2 times"):
         sup.run(2)
     assert hang.killed
@@ -95,7 +97,7 @@ def test_blacklisted_host_moves_task():
 
     sup = Supervisor(
         launch, hosts=["bad", "good"], max_attempt=3,
-        host_fail_limit=1, poll_interval=0,
+        host_fail_limit=1, poll_interval=0, relaunch_backoff=0,
     )
     sup.run(2)  # task 0 -> bad (fails, moves), task 1 -> good
     assert "bad" in sup.blacklist
@@ -113,6 +115,7 @@ def test_pinned_placement_aborts_on_blacklist():
     sup = Supervisor(
         launch, hosts=["p0", "p1"], max_attempt=5,
         host_fail_limit=1, allow_replacement=False, poll_interval=0,
+        relaunch_backoff=0,
     )
     with pytest.raises(JobAborted, match="cannot be re-placed"):
         sup.run(2)
@@ -124,10 +127,106 @@ def test_all_hosts_blacklisted_aborts():
 
     sup = Supervisor(
         launch, hosts=["h0"], max_attempt=10,
-        host_fail_limit=1, poll_interval=0,
+        host_fail_limit=1, poll_interval=0, relaunch_backoff=0,
     )
     with pytest.raises(JobAborted, match="every host is blacklisted"):
         sup.run(1)
+
+
+# -- relaunch backoff + host quarantine --------------------------------------
+
+
+def test_relaunch_backoff_is_exponential():
+    """Relaunches are spaced min(cap, base * 2**(k-1)) — a crash-looping
+    task cannot hammer the cluster at poll speed."""
+    import time as time_mod
+
+    def launch(task_id, host, attempt):
+        return FakeProc(1 if attempt < 3 else 0)
+
+    sup = Supervisor(
+        launch, hosts=["h0"], max_attempt=4, poll_interval=0,
+        relaunch_backoff=0.05, backoff_cap=10.0, quarantine_secs=0,
+    )
+    t0 = time_mod.perf_counter()
+    sup.run(1)
+    elapsed = time_mod.perf_counter() - t0
+    assert sup.backoffs == [0.05, 0.1, 0.2]
+    assert elapsed >= 0.35, "relaunches were not actually spaced"
+
+
+def test_relaunch_backoff_capped():
+    def launch(task_id, host, attempt):
+        return FakeProc(1 if attempt < 3 else 0)
+
+    sup = Supervisor(
+        launch, hosts=["h0"], max_attempt=4, poll_interval=0,
+        relaunch_backoff=0.01, backoff_cap=0.015, quarantine_secs=0,
+    )
+    sup.run(1)
+    assert sup.backoffs == [0.01, 0.015, 0.015]
+
+
+def test_quarantined_host_not_retried_when_alternative_exists():
+    """After a failure the host is quarantined: the relaunch moves to
+    another healthy host instead of the immediate same-host retry —
+    even though the failing host is NOT blacklisted."""
+    log = []
+
+    def launch(task_id, host, attempt):
+        log.append((task_id, host, attempt))
+        return FakeProc(1 if host == "h0" and attempt == 0 else 0)
+
+    sup = Supervisor(
+        launch, hosts=["h0", "h1"], max_attempt=3,
+        host_fail_limit=10,  # far from blacklisting
+        poll_interval=0, relaunch_backoff=0, quarantine_secs=30.0,
+    )
+    sup.run(1)
+    assert "h0" not in sup.blacklist
+    assert sup.quarantined.get("h0", 0) > 0
+    assert [(h, a) for (_t, h, a) in log] == [("h0", 0), ("h1", 1)]
+
+
+def test_quarantine_doubles_on_repeat_failures():
+    """Repeated failures on one host grow its quarantine window
+    exponentially — the 'host whose tasks die repeatedly' signal."""
+    import time as time_mod
+
+    def launch(task_id, host, attempt):
+        return FakeProc(1 if attempt < 2 else 0)
+
+    sup = Supervisor(
+        launch, hosts=["h0"], max_attempt=3, poll_interval=0,
+        relaunch_backoff=0, quarantine_secs=100.0,
+    )
+    releases = []
+    orig = sup._quarantine
+
+    def spy(host):
+        orig(host)
+        releases.append(sup.quarantined[host] - time_mod.monotonic())
+
+    sup._quarantine = spy
+    sup.run(1)
+    assert len(releases) == 2
+    assert releases[1] > releases[0] * 1.5  # doubled window
+
+
+def test_sole_quarantined_host_still_used():
+    """Liveness beats placement hygiene: with every healthy host
+    quarantined, the relaunch proceeds on the previous host."""
+
+    def launch(task_id, host, attempt):
+        return FakeProc(1 if attempt == 0 else 0)
+
+    sup = Supervisor(
+        launch, hosts=["only"], max_attempt=3, poll_interval=0,
+        relaunch_backoff=0, quarantine_secs=60.0,
+    )
+    sup.run(1)
+    assert sup.placement[0] == "only"
+    assert sup.relaunches == 1
 
 
 # -- end to end over the local backend ---------------------------------------

@@ -12,6 +12,9 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
   L003 mutable default argument (def f(x=[]) shares state across calls)
   L004 f-string without placeholders (usually a forgotten format arg)
   L005 duplicate dict key       (silently drops the earlier value)
+  L006 direct urlopen           (all remote HTTP must ride the transient-
+                                 failure retry layer; io/retry.py owns the
+                                 single urlopen call site and is exempt)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -143,12 +146,42 @@ def _check_duplicate_dict_keys(tree: ast.Module) -> Iterator[Tuple[int, str]]:
                     seen.add(key.value)
 
 
+def _check_direct_urlopen(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call whose target is (or resolves to) urllib.request.urlopen.
+    Catches ``urllib.request.urlopen(...)``, ``request.urlopen(...)``
+    and a bare ``urlopen(...)`` bound by ``from urllib.request import
+    urlopen`` (with or without an alias)."""
+    aliases = {"urlopen"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "urllib.request":
+            for alias in node.names:
+                if alias.name == "urlopen":
+                    aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in aliases) or (
+            isinstance(f, ast.Attribute) and f.attr == "urlopen"
+        )
+        if hit:
+            yield node.lineno, (
+                "direct urlopen call (route remote HTTP through the "
+                "retry layer, io/retry.py)"
+            )
+
+
+# files allowed to call urlopen directly: the retry layer itself (the
+# leading '/' anchors the path segment — audio/retry.py is NOT exempt)
+_L006_EXEMPT = ("/io/retry.py",)
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
     ("L003", _check_mutable_defaults),
     ("L004", _check_fstring_no_placeholder),
     ("L005", _check_duplicate_dict_keys),
+    ("L006", _check_direct_urlopen),
 ]
 
 
@@ -167,7 +200,10 @@ def lint_file(path: Path) -> List[Finding]:
     }
     out: List[Finding] = []
     rel = str(path.relative_to(REPO)) if path.is_relative_to(REPO) else str(path)
+    posix = path.as_posix()
     for code, fn in CHECKS:
+        if code == "L006" and posix.endswith(_L006_EXEMPT):
+            continue
         for line, msg in fn(tree):
             if line not in noqa_lines:
                 out.append((rel, line, code, msg))
