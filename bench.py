@@ -50,16 +50,27 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32768"))
 # slots, and StagingPipeline(depth=3, prefetch=2) keeps 8 alive
 _RING = 12
 # parse fan-out: >1 engages ShardedFusedBatches (threads; native kernels
-# release the GIL). Defaults to the core count on multi-core TPU hosts,
-# capped PER STREAM so every sub-shard still covers several full batches
-# — otherwise a many-core host over-shards the fixed-size data into
-# padded tails and the bench measures padding, not throughput.
+# release the GIL). Defaults to the USABLE core count (affinity mask and
+# cgroup cpu quota aware — utils/cpus.py; a containerized bench must not
+# size its pool to a host it can't run on), capped PER STREAM so every
+# sub-shard still covers several full batches — otherwise a many-core
+# host over-shards the fixed-size data into padded tails and the bench
+# measures padding, not throughput. BENCH_NTHREAD then DMLC_PARSE_THREADS
+# override.
 _nt_env = int(os.environ.get("BENCH_NTHREAD", "0"))
 
 
 def _nthread_for(rows: int):
-    nt = _nt_env or min(os.cpu_count() or 1, max(1, rows // (BATCH * 4)))
+    from dmlc_core_tpu.utils.cpus import parse_threads
+
+    nt = _nt_env or parse_threads(max(1, rows // (BATCH * 4)))
     return nt if nt > 1 else None
+
+
+def _avail_cpus() -> int:
+    from dmlc_core_tpu.utils.cpus import available_cpus
+
+    return available_cpus()
 
 
 DATA = os.environ.get(
@@ -505,10 +516,11 @@ def run_epoch(make_stream, value_dtype: str) -> dict:
     if last is not None:
         jax.block_until_ready(last[block_key])
     dt = time.perf_counter() - t0
-    # I/O-shape counters from the underlying split (shuffled indexed
-    # configs): spans ≪ records proves the coalescer is engaged, and
-    # seeks=0 proves the local pread fast path carried the spans
-    io_stats = getattr(stream, "io_stats", lambda: None)()
+    # I/O-shape counters: the split's (spans ≪ records proves the
+    # coalescer is engaged, seeks=0 proves the local pread fast path
+    # carried them) merged with the pipeline's staging counters under
+    # "staging" (put counts, packed/per-array path mix, unpack-cache LRU)
+    io_stats = pipe.io_stats()
     # pipeline first, source second — and only when the teardown join
     # completed (close_timed_out): an orphaned producer thread may still
     # be reading the stream's ring/mmap buffers
@@ -560,7 +572,7 @@ def raw_infeed_probe(batch_bytes: int, n_batches: int) -> dict:
     inflight = []
     t0 = time.perf_counter()
     for i in range(n_batches):
-        inflight.append(jax.device_put(ring[i % len(ring)]))
+        inflight.append(jax.device_put(ring[i % len(ring)]))  # noqa: L007 (raw link probe)
         if len(inflight) >= depth:
             jax.block_until_ready(inflight.pop(0))
     for dev in inflight:
@@ -606,7 +618,7 @@ class LinkProbe:
                 np.int64(self._n).tobytes(), dtype=np.uint8
             )
             self._n += 1
-            jax.block_until_ready(jax.device_put(b))
+            jax.block_until_ready(jax.device_put(b))  # noqa: L007 (raw link probe)
             nb += b.nbytes
         dt = max(time.perf_counter() - t0, 1e-9)
         mb = nb / dt / 1e6
@@ -644,7 +656,7 @@ class LinkProbe:
             )
             self._n += 1
             t0 = time.perf_counter()
-            jax.block_until_ready(jax.device_put(b))
+            jax.block_until_ready(jax.device_put(b))  # noqa: L007 (raw link probe)
             times.append(time.perf_counter() - t0)
         nb = self._bufs[0].nbytes
         half = times[len(times) // 2:]
@@ -858,7 +870,18 @@ def main() -> None:
                 "fused_csv_kernel": native.HAS_CSV_DENSE,
                 "fused_libfm_kernel": native.HAS_LIBFM_ELL,
                 "fused_libsvm_ell_kernel": native.HAS_LIBSVM_ELL,
+                # staging transfer shape for the headline recordio config:
+                # device_puts ≈ n_batches (ONE DMA per batch on the packed
+                # path — the whole ISSUE 3 point), dispatch ring depth,
+                # and the unpacker-cache LRU counters
+                "staging_rec": series["rec_f16"][0]
+                .get("io_stats", {})
+                .get("staging"),
                 "host_cpus": os.cpu_count(),
+                # usable CPUs: affinity-mask + cgroup-quota aware — what
+                # the parse pools are actually sized from (utils/cpus.py,
+                # DMLC_PARSE_THREADS overrides)
+                "avail_cpus": _avail_cpus(),
                 "parse_threads": _nthread_for(N_ROWS) or 1,
             }
         )

@@ -3,11 +3,14 @@
 Runs the rec f16 staged epoch with per-stage timing, then isolates each
 suspect cost on the same host/device:
 
-  A. staged epoch w/ stage breakdown (host_pull / stage_dispatch / wait)
+  A. staged epoch w/ stage breakdown (host_pull / dispatch_pack /
+     dispatch_put / slot_wait / transfer_wait)
   B. device_put-only of the packed buffers (no jit unpack)
-  C. device_put + jit unpack (the production stage_batch path)
+  C. device_put + jit unpack, serial (the pre-ring stage_batch path)
   D. raw probe (prestaged random buffers, same shape/depth)
   E. host-only parse epoch (fused producer, no device)
+  F. pack + ring-parallel put/unpack (the production dispatch ring
+     isolated; F vs C is the dispatch-parallel win)
 
 Prints one JSON blob. Not part of the bench contract; a scalpel.
 """
@@ -84,7 +87,7 @@ def put_only_epoch(unpack: bool):
     for b in stream:
         if b.packed is None:
             raise RuntimeError("no packed buffer")
-        u8 = jax.device_put(_safe_host(b.packed, dev.platform), dev)
+        u8 = jax.device_put(_safe_host(b.packed, dev.platform), dev)  # noqa: L007 (raw link probe)
         if unpack:
             layout = _packed_layout(b)
             u8 = _unpacker(layout, dev.platform)(u8)
@@ -100,6 +103,64 @@ def put_only_epoch(unpack: bool):
     return {"secs": dt, "rows_per_sec": rows / dt, "batches": n}
 
 
+def ring_put_epoch(workers: int = 3):
+    """The dispatch ring isolated: pack each packed batch into a stable
+    fresh copy on THIS thread, dispatch the put+unpack on ``workers``
+    pool threads (production ``_put_packed``), resolve in order. The
+    delta vs C (serial put+unpack) is the dispatch-parallel win — on
+    frontends where device_put blocks for the transfer's duration, C is
+    serial-transfer-bound and this overlaps ``workers`` transfers.
+
+    The pack copy is UNCONDITIONAL (np.array, fresh each batch), unlike
+    ``_pack_single(…, slot=None)`` which skips the copy off-CPU: the
+    production ring always pays one host memcpy per batch (into its
+    reusable slot), and the async puts here must never read live
+    producer ring slots — so this stage pays the same memcpy and stays
+    aliasing-safe at any ``workers``."""
+    import concurrent.futures as cf
+
+    import jax
+
+    from dmlc_core_tpu.staging.pipeline import (
+        _packed_layout,
+        _put_packed,
+    )
+
+    import numpy as np
+
+    stream, _key, _ = bench._make_rec_stream("float16")
+    dev = jax.local_devices()[0]
+    pool = cf.ThreadPoolExecutor(max_workers=workers)
+    t0 = time.perf_counter()
+    inflight = []
+    n = 0
+    rows = 0
+    pack_s = 0.0
+    for b in stream:
+        if b.packed is None:
+            raise RuntimeError("no packed buffer")
+        layout = _packed_layout(b)
+        tp = time.perf_counter()
+        src = np.array(b.packed, copy=True)
+        pack_s += time.perf_counter() - tp
+        inflight.append(pool.submit(_put_packed, src, layout, dev, None))
+        n += 1
+        rows += b.n_valid
+        if len(inflight) >= workers:
+            jax.block_until_ready(inflight.pop(0).result())
+    for f in inflight:
+        jax.block_until_ready(f.result())
+    dt = time.perf_counter() - t0
+    pool.shutdown()
+    stream.close()
+    return {
+        "secs": dt,
+        "rows_per_sec": rows / dt,
+        "batches": n,
+        "pack_secs": round(pack_s, 4),
+    }
+
+
 def main():
     bench.ensure_native()
     bench.ensure_rec_data()
@@ -113,6 +174,7 @@ def main():
         out[f"A_staged_{r}"] = staged_epoch()
         out[f"B_put_only_{r}"] = put_only_epoch(unpack=False)
         out[f"C_put_unpack_{r}"] = put_only_epoch(unpack=True)
+        out[f"F_ring_put_{r}"] = ring_put_epoch()
         out[f"E_host_only_{r}"] = bench.host_epoch(bench._make_rec_stream)
         nb = out["packed_nbytes"][0]
         nbatches = out[f"A_staged_{r}"]["batches"]

@@ -47,7 +47,7 @@ def put_loop(bufs, n, between=None):
     put_secs = 0.0
     for i in range(n):
         tp = time.perf_counter()
-        d = jax.device_put(bufs[i % len(bufs)])
+        d = jax.device_put(bufs[i % len(bufs)])  # noqa: L007 (raw link probe)
         jax.block_until_ready(d)
         put_secs += time.perf_counter() - tp
         if between is not None:

@@ -12,7 +12,6 @@ include/dmlc/common.h:53-87) via concurrent.futures result().
 
 from __future__ import annotations
 
-import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional
 
@@ -32,17 +31,16 @@ def default_parser_threads(nthread: Optional[int]) -> int:
     min(requested, max(procs/2 - 4, 1)) (text_parser.h:33-34, default 2
     from data.cc:29): that throttle assumes the learner competes for host
     CPU, but on a TPU host the CPU idles during the device step, so the
-    parser gets every core by default. Requests are still capped at the
-    core count (extra threads only add GIL churn), and
-    DMLC_TPU_PARSER_THREADS overrides both.
+    parser gets every USABLE core by default — usable meaning the
+    affinity-mask/cgroup-quota-aware count (utils/cpus.py), not the raw
+    host core count a container may never see. Requests are still capped
+    at that count (extra threads only add GIL churn);
+    ``DMLC_PARSE_THREADS`` overrides both (``DMLC_TPU_PARSER_THREADS``
+    kept as a legacy alias).
     """
-    env = os.environ.get("DMLC_TPU_PARSER_THREADS")
-    if env:
-        return max(1, int(env))
-    procs = os.cpu_count() or 1
-    if nthread is None:
-        return procs
-    return max(1, min(nthread, procs))
+    from ..utils.cpus import parse_threads
+
+    return parse_threads(nthread)
 
 
 class TextParserBase(Parser):

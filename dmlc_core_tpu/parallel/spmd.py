@@ -17,11 +17,14 @@ __all__ = ["replicate", "shard_params", "data_parallel_step"]
 
 def replicate(tree, mesh):
     """Place a pytree fully replicated over the mesh."""
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    # parameter placement is a one-shot transfer, not batch staging —
+    # it rides the staging layer's sanctioned device_put (lint L007)
+    from ..staging.pipeline import device_put
+
     sharding = NamedSharding(mesh, PartitionSpec())
-    return jax.device_put(tree, sharding)
+    return device_put(tree, sharding)
 
 
 def shard_params(
@@ -35,14 +38,15 @@ def shard_params(
 
         shard_params(params, mesh, {"v": P(None, "model")})
     """
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec
+
+    from ..staging.pipeline import device_put
 
     rules = rules or {}
     out = {}
     for name, value in params.items():
         spec = rules.get(name, PartitionSpec())
-        out[name] = jax.device_put(value, NamedSharding(mesh, spec))
+        out[name] = device_put(value, NamedSharding(mesh, spec))
     return out
 
 

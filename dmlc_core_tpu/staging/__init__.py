@@ -5,7 +5,13 @@ steps 4-5): ragged RowBlocks → static-shape batches → async device_put with
 bounded in-flight depth, optionally sharded over a jax Mesh data axis.
 """
 
-from .batcher import Batch, BatchSpec, FixedShapeBatcher
+from .batcher import (
+    Batch,
+    BatchSpec,
+    FixedShapeBatcher,
+    alloc_packed_slot,
+    packed_shard_layout,
+)
 from .fused import (
     FusedDenseCSVBatches,
     FusedDenseLibSVMBatches,
@@ -16,7 +22,14 @@ from .fused import (
     dense_batches,
     ell_batches,
 )
-from .pipeline import StagingPipeline, drain_close, stage_batch
+from .pipeline import (
+    StagingPipeline,
+    StagingStats,
+    device_put,
+    drain_close,
+    stage_batch,
+    unpack_cache_stats,
+)
 
 __all__ = [
     "Batch",
@@ -29,8 +42,13 @@ __all__ = [
     "FusedEllRowRecBatches",
     "ShardedFusedBatches",
     "StagingPipeline",
+    "StagingStats",
+    "alloc_packed_slot",
     "dense_batches",
+    "device_put",
     "drain_close",
     "ell_batches",
+    "packed_shard_layout",
     "stage_batch",
+    "unpack_cache_stats",
 ]

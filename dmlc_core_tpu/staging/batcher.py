@@ -26,7 +26,74 @@ import numpy as np
 from ..data.row_block import RowBlock
 from ..utils.logging import Error, check
 
-__all__ = ["Batch", "BatchSpec", "FixedShapeBatcher"]
+__all__ = [
+    "Batch",
+    "BatchSpec",
+    "FixedShapeBatcher",
+    "alloc_packed_slot",
+    "packed_shard_layout",
+]
+
+# every section (and every per-shard segment) starts on an 8-byte
+# boundary: the widest staged dtype is 8 bytes, so both the host numpy
+# views and the on-device bitcast unpack (pipeline.py) always see
+# aligned data, whole-batch or per-shard
+PACK_ALIGN = 8
+
+
+def _aligned(nbytes: int) -> int:
+    return (nbytes + PACK_ALIGN - 1) & ~(PACK_ALIGN - 1)
+
+
+def alloc_packed_slot(sections):
+    """One contiguous uint8 buffer + named views into it.
+
+    ``sections`` is [(name, shape, dtype)]; each section's offset is
+    PACK_ALIGN-aligned. Returns (buf, views). The single buffer is what
+    lets the staging pipeline move a whole batch as ONE device transfer
+    (or one per mesh shard) instead of one per array.
+    """
+    offs = []
+    off = 0
+    for _name, shape, dtype in sections:
+        nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        offs.append((off, nb))
+        off += _aligned(nb)
+    buf = np.zeros(off, dtype=np.uint8)
+    views = {}
+    for (o, nb), (name, shape, dtype) in zip(offs, sections):
+        views[name] = buf[o : o + nb].view(dtype).reshape(shape)
+    return buf, views
+
+
+def packed_shard_layout(entries, n_shards: int):
+    """Per-shard packing plan for a leading-dim sharded batch.
+
+    ``entries`` is [(name, shape, dtype)] with every shape's leading dim
+    divisible by ``n_shards`` (the batcher emits fixed ``batch_size``
+    rows, so callers pick batch sizes that divide; anything else returns
+    None and the caller falls back to per-array transfers). Returns
+    (shard_entries, stride): ``shard_entries`` is
+    [(name, seg_off, seg_nbytes, global_shape, dtype)] where ``seg_off``
+    is the PACK_ALIGN-aligned offset of the array's rows INSIDE one
+    shard's contiguous block, and ``stride`` is the aligned size of that
+    block — shard ``d`` of the whole batch occupies bytes
+    ``[d*stride, (d+1)*stride)`` of the repacked staging buffer, so each
+    shard rides one contiguous DMA. Alignment padding bytes are sliced
+    off again by the on-device unpack.
+    """
+    shard_entries = []
+    off = 0
+    for name, shape, dtype in entries:
+        if not shape or shape[0] % n_shards:
+            return None
+        rows = shape[0] // n_shards
+        nb = rows * int(np.prod(shape[1:], dtype=np.int64)) * np.dtype(
+            dtype
+        ).itemsize
+        shard_entries.append((name, off, int(nb), tuple(shape), str(dtype)))
+        off += _aligned(int(nb))
+    return tuple(shard_entries), off
 
 
 @dataclass(frozen=True)
@@ -138,8 +205,20 @@ class FixedShapeBatcher:
                     f"(worst row has {int(nnz_per_row.max())})"
                 )
             self.truncated_nnz += n_over
-        indices = np.zeros((B, K), dtype=spec.index_dtype)
-        values = np.zeros((B, K), dtype=spec.value_dtype)
+        # one contiguous buffer per batch (fresh — nothing recycles it),
+        # same slot layout as the fused ELL producers: the staging
+        # pipeline stages generic-parser batches with the same single-DMA
+        # (and packed-shard mesh) fast path the native kernels get
+        packed, v = alloc_packed_slot(
+            [
+                ("indices", (B, K), spec.index_dtype),
+                ("values", (B, K), spec.value_dtype),
+                ("nnz", (B,), np.int32),
+                ("labels", (B,), np.float32),
+                ("weights", (B,), np.float32),
+            ]
+        )
+        indices, values = v["indices"], v["values"]
         m = len(nnz_per_row)
         # fast path: uniform row width that fits K and the index dtype →
         # plain reshape+copy, no position scatter
@@ -195,21 +274,28 @@ class FixedShapeBatcher:
             np.add.at(nnz_kept, row_ids[keep], 1)
         else:
             nnz_kept = np.zeros(m, dtype=np.int64)
-        nnz = np.zeros(B, dtype=np.int32)
+        nnz, labels, weights = v["nnz"], v["labels"], v["weights"]
         nnz[:m] = nnz_kept
-        labels = np.zeros(B, dtype=np.float32)
         labels[:m] = blk.label
-        weights = np.zeros(B, dtype=np.float32)
         weights[:m] = 1.0 if blk.weight is None else blk.weight
         return Batch(
             labels=labels, weights=weights, n_valid=n_valid,
-            indices=indices, values=values, nnz=nnz,
+            indices=indices, values=values, nnz=nnz, packed=packed,
         )
 
     def _to_dense(self, blk: RowBlock, n_valid: int) -> Batch:
         spec = self.spec
         B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        x = np.zeros((B, D), dtype=spec.value_dtype)
+        # same contiguous layout as the fused dense producers (one DMA /
+        # packed-shard staging for generic-parser batches too)
+        packed, v = alloc_packed_slot(
+            [
+                ("x", (B, D), spec.value_dtype),
+                ("labels", (B,), np.float32),
+                ("weights", (B,), np.float32),
+            ]
+        )
+        x = v["x"]
         m = blk.size
         if blk.nnz:
             nnz_per_row = np.diff(blk.offset)
@@ -248,11 +334,11 @@ class FixedShapeBatcher:
                 # sparse dot semantics
                 with np.errstate(over="ignore"):
                     np.add.at(x, (row_ids[keep], idx[keep]), vals[keep])
-        labels = np.zeros(B, dtype=np.float32)
+        labels, weights = v["labels"], v["weights"]
         labels[:m] = blk.label
-        weights = np.zeros(B, dtype=np.float32)
         weights[:m] = 1.0 if blk.weight is None else blk.weight
-        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x)
+        return Batch(labels=labels, weights=weights, n_valid=n_valid, x=x,
+                     packed=packed)
 
     def _emit(self, blk: RowBlock) -> Batch:
         n_valid = blk.size

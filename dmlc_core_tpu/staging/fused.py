@@ -30,7 +30,13 @@ whole batches.
 Producers expose ``ring_slots`` so consumers composing them with a
 prefetch/in-flight pipeline (StagingPipeline) can validate the ring is
 deep enough — a yielded batch is only valid until ``ring_slots - 1``
-further batches have been produced.
+further batches have been produced. That is the whole handoff contract:
+the pipeline's dispatch ring copies ``Batch.packed`` into its own slot
+buffer at pack time (docs/staging.md), so a producer's slot is free for
+recycling the moment the pipeline starts the NEXT batch — but the
+pipeline still validates rings against its conservative worst case
+(prefetch + depth + 3) because per-array-fallback batches (no usable
+packed layout) stay referenced until their DMA completes.
 """
 
 from __future__ import annotations
@@ -46,7 +52,7 @@ from ..io import split as io_split
 from ..io.filesystem import FileSystem
 from ..io.uri import URISpec, rejoin_query, uri_int
 from ..utils.logging import Error, check
-from .batcher import Batch, BatchSpec
+from .batcher import Batch, BatchSpec, alloc_packed_slot
 
 __all__ = [
     "FusedDenseCSVBatches",
@@ -59,26 +65,6 @@ __all__ = [
 
 _BOM = b"\xef\xbb\xbf"
 _MMAP_CHUNK = 32 << 20
-
-
-def _alloc_packed_slot(sections):
-    """One contiguous uint8 buffer + named views into it.
-
-    ``sections`` is [(name, shape, dtype)]; each section's offset is
-    8-byte aligned so the on-device bitcast unpack (pipeline.py) and the
-    host-side numpy views both see aligned data. Returns (buf, views).
-    """
-    offs = []
-    off = 0
-    for _name, shape, dtype in sections:
-        nb = int(np.prod(shape)) * np.dtype(dtype).itemsize
-        offs.append((off, nb))
-        off += (nb + 7) & ~7
-    buf = np.zeros(off, dtype=np.uint8)
-    views = {}
-    for (o, nb), (name, shape, dtype) in zip(offs, sections):
-        views[name] = buf[o : o + nb].view(dtype).reshape(shape)
-    return buf, views
 
 
 def _plain_local_path(uri: str) -> Optional[str]:
@@ -339,7 +325,7 @@ class _FusedDenseTextBatches(_FusedTextBatches):
     def _alloc_slot(self):
         spec = self.spec
         B, D = spec.batch_size, int(spec.num_features)  # type: ignore[arg-type]
-        buf, v = _alloc_packed_slot(
+        buf, v = alloc_packed_slot(
             [
                 ("x", (B, D), spec.value_dtype),
                 ("labels", (B,), np.float32),
@@ -472,7 +458,7 @@ class _EllSlotMixin:
     def _alloc_ell_slot(self):
         spec = self.spec
         B, K = spec.batch_size, int(spec.max_nnz)  # type: ignore[arg-type]
-        buf, v = _alloc_packed_slot(
+        buf, v = alloc_packed_slot(
             [
                 ("indices", (B, K), np.int32),
                 ("values", (B, K), spec.value_dtype),

@@ -364,13 +364,20 @@ def test_threaded_iter_before_first_raises_pending_error():
 
 
 def test_default_parser_threads_tpu_host_policy(monkeypatch):
-    """TPU-host divergence: no procs//2-4 throttle; env var overrides."""
+    """TPU-host divergence: no procs//2-4 throttle; env var overrides.
+    Sizing derives from the AVAILABLE (affinity/quota-aware) cpu count,
+    not the raw host count (utils/cpus.py)."""
     from dmlc_core_tpu.data.text_parser import default_parser_threads
 
-    monkeypatch.setattr("os.cpu_count", lambda: 8)
-    assert default_parser_threads(None) == 8  # all cores by default
-    assert default_parser_threads(16) == 8  # capped at core count
+    monkeypatch.delenv("DMLC_PARSE_THREADS", raising=False)
+    monkeypatch.setattr(
+        "dmlc_core_tpu.utils.cpus.available_cpus", lambda: 8
+    )
+    assert default_parser_threads(None) == 8  # all usable cores by default
+    assert default_parser_threads(16) == 8  # capped at usable count
     assert default_parser_threads(3) == 3
-    monkeypatch.setenv("DMLC_TPU_PARSER_THREADS", "5")
+    monkeypatch.setenv("DMLC_TPU_PARSER_THREADS", "5")  # legacy alias
     assert default_parser_threads(None) == 5
     assert default_parser_threads(2) == 5  # env wins
+    monkeypatch.setenv("DMLC_PARSE_THREADS", "7")  # documented knob wins
+    assert default_parser_threads(None) == 7

@@ -102,6 +102,41 @@ def test_urlopen_quiet_in_retry_layer(tmp_path):
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
 
 
+def test_direct_device_put_flagged(tmp_path):
+    src = "import jax\njax.device_put(x)\n"
+    assert codes(src, tmp_path) == ["L007"]
+    src = "from jax import device_put\ndevice_put(x)\n"
+    assert codes(src, tmp_path) == ["L007"]
+    # an alias does not dodge the rule
+    src = "from jax import device_put as dp\ndp(x)\n"
+    assert codes(src, tmp_path) == ["L007"]
+    # any attribute call counts (jnp/numpy-style indirection)
+    src = "import jax.numpy\njax.numpy.device_put(x)\n"
+    assert codes(src, tmp_path) == ["L007"]
+
+
+def test_device_put_sanctioned_wrapper_quiet(tmp_path):
+    """The staging layer's wrapper imported as a bare name is the
+    sanctioned escape hatch (spmd.py parameter placement)."""
+    src = (
+        "from dmlc_core_tpu.staging.pipeline import device_put\n"
+        "device_put(x)\n"
+    )
+    assert codes(src, tmp_path) == []
+    # per-line opt-out for raw link probes
+    src = "import jax\njax.device_put(x)  # noqa: L007 (raw probe)\n"
+    assert codes(src, tmp_path) == []
+
+
+def test_device_put_quiet_in_staging_layer(tmp_path):
+    """dmlc_core_tpu/staging/ owns the transfer call sites."""
+    d = tmp_path / "dmlc_core_tpu" / "staging"
+    d.mkdir(parents=True)
+    f = d / "pipeline.py"
+    f.write_text("import jax\njax.device_put(x)\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -171,16 +171,28 @@ def test_staging_pipeline_end_to_end():
     assert pipe.rows_staged == 30 and pipe.batches_staged == 4
     stats = pipe.throughput()
     assert stats["rows"] == 30 and stats["rows_per_sec"] > 0
-    # per-stage breakdown (VERDICT r4 weak #1): all three phases ticked
-    # and are reported both on the attribute and through throughput()
+    # per-stage breakdown (VERDICT r4 weak #1), with the dispatch split
+    # into pack/put by the dispatch ring (ISSUE 3): every phase ticked
+    # and reported both on the attribute and through throughput()
     assert set(pipe.stage_seconds) == {
-        "host_pull", "stage_dispatch", "transfer_wait",
+        "host_pull", "dispatch_pack", "dispatch_put",
+        "dispatch_slot_wait", "stage_dispatch", "transfer_wait",
     }
     assert all(v >= 0 for v in pipe.stage_seconds.values())
     assert pipe.stage_seconds["stage_dispatch"] > 0
+    assert pipe.stage_seconds["stage_dispatch"] == pytest.approx(
+        pipe.stage_seconds["dispatch_pack"]
+        + pipe.stage_seconds["dispatch_put"]
+    )
     assert stats["secs_stage_dispatch"] == (
         pipe.stage_seconds["stage_dispatch"]
     )
+    # packed single-DMA path engaged (the generic batcher packs too now)
+    st = pipe.staging_stats()
+    assert st["packed_batches"] == 4 and st["per_array_batches"] == 0
+    assert st["device_puts"] == 4  # ONE put per batch, not one per array
+    assert st["packed_shard_dma"] is False
+    assert pipe.io_stats()["staging"]["packed_batches"] == 4
     pipe.close()
 
 

@@ -15,6 +15,13 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
   L006 direct urlopen           (all remote HTTP must ride the transient-
                                  failure retry layer; io/retry.py owns the
                                  single urlopen call site and is exempt)
+  L007 direct jax.device_put    (all host→device transfers must ride the
+                                 coalesced staging layer; dmlc_core_tpu/
+                                 staging/ owns the call sites and is
+                                 exempt, tests/ may build device fixtures,
+                                 and link probes opt out per line with
+                                 `# noqa: L007`. Non-batch placements go
+                                 through staging.device_put.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -171,9 +178,46 @@ def _check_direct_urlopen(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+def _check_direct_device_put(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call whose target resolves to jax's device_put: the staging
+    layer (dmlc_core_tpu/staging/) owns every host→device transfer so
+    batches always ride the coalesced single-DMA / packed-shard paths.
+    Catches ``jax.device_put(...)``, any ``X.device_put(...)`` attribute
+    call, and a bare ``device_put(...)`` bound by ``from jax import
+    device_put`` (with or without an alias). The staging layer's own
+    ``device_put`` wrapper imported as a bare name is NOT flagged — that
+    wrapper is the sanctioned escape hatch for non-batch placements."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "device_put":
+                    aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in aliases) or (
+            isinstance(f, ast.Attribute) and f.attr == "device_put"
+        )
+        if hit:
+            yield node.lineno, (
+                "direct device_put call (host→device transfers belong to "
+                "the staging layer; import staging.device_put for "
+                "non-batch placements)"
+            )
+
+
 # files allowed to call urlopen directly: the retry layer itself (the
 # leading '/' anchors the path segment — audio/retry.py is NOT exempt)
 _L006_EXEMPT = ("/io/retry.py",)
+# trees allowed to call jax.device_put directly: the staging layer owns
+# the transfer call sites; tests build device-resident fixtures.
+# Anchored against the REPO-RELATIVE path (a checkout living under e.g.
+# /home/ci/tests/ must not exempt the whole repo); files outside the
+# repo (lint_file called on scratch dirs, as the lint's own tests do)
+# fall back to an absolute-path segment match.
+_L007_EXEMPT_DIRS = ("dmlc_core_tpu/staging/", "tests/")
 
 CHECKS = [
     ("L001", _check_unused_imports),
@@ -182,6 +226,7 @@ CHECKS = [
     ("L004", _check_fstring_no_placeholder),
     ("L005", _check_duplicate_dict_keys),
     ("L006", _check_direct_urlopen),
+    ("L007", _check_direct_device_put),
 ]
 
 
@@ -201,8 +246,16 @@ def lint_file(path: Path) -> List[Finding]:
     out: List[Finding] = []
     rel = str(path.relative_to(REPO)) if path.is_relative_to(REPO) else str(path)
     posix = path.as_posix()
+    in_repo = path.is_relative_to(REPO)
+    rel_posix = rel.replace("\\", "/") if in_repo else None
     for code, fn in CHECKS:
         if code == "L006" and posix.endswith(_L006_EXEMPT):
+            continue
+        if code == "L007" and (
+            rel_posix.startswith(_L007_EXEMPT_DIRS)
+            if in_repo
+            else any("/" + d in posix for d in _L007_EXEMPT_DIRS)
+        ):
             continue
         for line, msg in fn(tree):
             if line not in noqa_lines:
