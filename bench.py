@@ -689,6 +689,12 @@ def run_series(tasks, rounds: int, probe: "LinkProbe"):
     return results
 
 
+def _telemetry_snapshot() -> dict:
+    from dmlc_core_tpu.telemetry import to_json
+
+    return to_json()
+
+
 def main() -> None:
     ensure_native()
     ensure_data()
@@ -883,6 +889,13 @@ def main() -> None:
                 # DMLC_PARSE_THREADS overrides)
                 "avail_cpus": _avail_cpus(),
                 "parse_threads": _nthread_for(N_ROWS) or 1,
+                # full telemetry snapshot (docs/observability.md): the
+                # registry every producer ticked during the run — stage
+                # duration HISTOGRAMS with percentiles (not just the
+                # stage_secs_* sums), io.split shape, retry/fault
+                # counters, staging path mix. The perf trajectory now
+                # captures tails round over round.
+                "telemetry": _telemetry_snapshot(),
             }
         )
     )

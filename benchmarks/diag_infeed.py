@@ -180,6 +180,11 @@ def main():
         nbatches = out[f"A_staged_{r}"]["batches"]
         out[f"D_raw_{r}"] = bench.raw_infeed_probe(nb, nbatches)
     print(json.dumps(out, indent=1, default=float))
+    # exit dump of the telemetry registry: the same epochs as stage
+    # duration HISTOGRAMS (p50/p90/p99 per stage) next to the A-F sums
+    from dmlc_core_tpu.telemetry import to_json as telemetry_snapshot
+
+    print("telemetry: " + json.dumps(telemetry_snapshot(), default=float))
 
 
 if __name__ == "__main__":

@@ -106,12 +106,23 @@ def shuffle_read_modes(fault: str = ""):
     return out
 
 
+def _print_telemetry() -> None:
+    """Exit dump of the process telemetry registry: every counter the
+    drained layers ticked (split shape, retry/fault, staging) in one
+    place — starvation diagnosis no longer means grepping the scattered
+    per-mode io_stats dicts above it."""
+    from dmlc_core_tpu.telemetry import to_json
+
+    print("telemetry: " + json.dumps(to_json()))
+
+
 def main():
     if "--shuffle" in sys.argv:
         fault = ""
         if "--fault" in sys.argv:  # e.g. --fault resets=2,errors=1,seed=7
             fault = sys.argv[sys.argv.index("--fault") + 1]
         print(json.dumps(shuffle_read_modes(fault), indent=1))
+        _print_telemetry()
         return
     import jax
 
@@ -133,6 +144,7 @@ def main():
         )
         out[f"pyspin20ms_{r}"] = put_loop(bufs, N, lambda: spin(0.020))
     print(json.dumps(out, indent=1))
+    _print_telemetry()
 
 
 if __name__ == "__main__":

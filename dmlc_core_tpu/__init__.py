@@ -25,6 +25,10 @@ Brand-new design (not a port) providing, TPU-first:
 - ``tracker``     : dmlc-submit compatible launcher: rank rendezvous tracker,
                     tree+ring topology, cluster backends incl. ``tpu-pod``
                     (reference: tracker/dmlc_tracker/)
+- ``telemetry``   : unified host-side telemetry — process-global metric
+                    registry (counters/gauges/log-bucketed histograms),
+                    Prometheus/JSON exporters, tracker-wide heartbeat
+                    aggregation (new; the reference logs MB/sec lines)
 
 The native C++ fast path for parsing/RecordIO lives in ``native/`` and is loaded
 via ctypes when available; every component has a pure-Python/numpy fallback.

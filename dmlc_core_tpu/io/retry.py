@@ -21,10 +21,13 @@ policy every remote touchpoint shares:
   SeekStream backends (the ``fault://`` filesystem wraps its injected
   streams in one, so chaos tests exercise exactly this code path).
 - process-global ``retries`` / ``backoff_secs`` / ``faults_injected``
-  counters surfaced through the ``io_stats()`` plumbing (split → fused
-  staging → pipeline → bench). Counters are process-global; per-split
-  ``io_stats`` reports the delta since the split was constructed, so
-  concurrent splits in one process see overlapping attributions.
+  counters — telemetry-registry series (``io.retry.retries``,
+  ``io.retry.backoff_seconds``, ``io.faults.injected``; see
+  docs/observability.md) surfaced through the ``io_stats()`` plumbing
+  (split → fused staging → pipeline → bench) as a bit-compatible view.
+  Counters are process-global; per-split ``io_stats`` reports the delta
+  since the split was constructed, so concurrent splits in one process
+  see overlapping attributions.
 
 Env knobs (read at policy construction): DMLC_RETRY_ATTEMPTS (4),
 DMLC_RETRY_BASE_SECS (0.1), DMLC_RETRY_CAP_SECS (5.0),
@@ -43,8 +46,11 @@ import urllib.error
 import urllib.request
 from typing import Callable, Dict, Optional
 
+from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error
 from .stream import SeekStream
+
+_registry = _default_registry()
 
 __all__ = [
     "HttpError",
@@ -107,36 +113,57 @@ def is_transient(exc: BaseException) -> bool:
 
 
 # -- process-global counters (io_stats plumbing) ------------------------------
+# Backed by the telemetry registry since ISSUE 4: the same series a
+# Prometheus scrape or tracker heartbeat reports. stats()/stats_delta()
+# remain the bit-compatible io_stats() view over those counters — a
+# registry ScopedView over the three series; the registry counters stay
+# monotonic (exporters need that), so reset_stats() rebases the view
+# instead of zeroing them.
 
-_STATS_LOCK = threading.Lock()
-_STATS: Dict[str, float] = {
-    "retries": 0,
-    "backoff_secs": 0.0,
-    "faults_injected": 0,
-}
+_RETRIES = _registry.counter(
+    "io.retry.retries", help="transient-failure retries healed"
+)
+_BACKOFF = _registry.counter(
+    "io.retry.backoff_seconds", help="total retry backoff slept (secs)"
+)
+_FAULTS = _registry.counter(
+    "io.faults.injected", help="faults fired by the fault:// layer"
+)
+
+_SERIES = ("io.retry.retries", "io.retry.backoff_seconds", "io.faults.injected")
+_VIEW_LOCK = threading.Lock()  # guards the shared view's baseline swap
+_VIEW = _registry.scoped(names=_SERIES)
 
 
 def _count_retry(backoff: float) -> None:
-    with _STATS_LOCK:
-        _STATS["retries"] += 1
-        _STATS["backoff_secs"] += backoff
+    _RETRIES.inc()
+    _BACKOFF.inc(backoff)
 
 
 def count_fault_injected(n: int = 1) -> None:
     """Called by the fault-injection layer (io/faults.py) per fired
     fault, so injected chaos is observable next to the healed retries."""
-    with _STATS_LOCK:
-        _STATS["faults_injected"] += n
+    _FAULTS.inc(n)
 
 
 def stats() -> Dict[str, float]:
-    """Snapshot of the process-global counters."""
-    with _STATS_LOCK:
-        out = dict(_STATS)
-    out["retries"] = int(out["retries"])
-    out["faults_injected"] = int(out["faults_injected"])
-    out["backoff_secs"] = round(float(out["backoff_secs"]), 6)
-    return out
+    """Snapshot of the process-global counters (registry values minus
+    the reset_stats() baseline — a ScopedView delta, remapped to the
+    golden io_stats() keys).
+
+    The three counters are read without a joint lock (each is
+    independently thread-sharded), so a retry completing mid-read can
+    skew one field against another by one increment — reporting-only
+    jitter; read after quiescing for exact triples (as the chaos tests
+    do). The old single-lock dict guaranteed a consistent triple; the
+    trade buys lock-free hot-path increments."""
+    with _VIEW_LOCK:
+        d = _VIEW.delta()
+    return {
+        "retries": int(d.get("io.retry.retries", 0)),
+        "backoff_secs": round(float(d.get("io.retry.backoff_seconds", 0.0)), 6),
+        "faults_injected": int(d.get("io.faults.injected", 0)),
+    }
 
 
 def stats_delta(snapshot: Dict[str, float]) -> Dict[str, float]:
@@ -154,11 +181,10 @@ def stats_delta(snapshot: Dict[str, float]) -> Dict[str, float]:
 
 
 def reset_stats() -> None:
-    """Zero the global counters (test isolation)."""
-    with _STATS_LOCK:
-        _STATS["retries"] = 0
-        _STATS["backoff_secs"] = 0.0
-        _STATS["faults_injected"] = 0
+    """Zero the stats() view (test isolation). The underlying registry
+    counters stay monotonic — only the view's baseline moves."""
+    with _VIEW_LOCK:
+        _VIEW.rebase()
 
 
 def _env_float(name: str, default: float) -> float:

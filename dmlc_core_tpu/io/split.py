@@ -27,6 +27,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
+from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error, check, check_eq
 from . import retry as _retry
 from . import serializer
@@ -57,6 +58,17 @@ __all__ = [
 # 8 MB chunk buffer (reference kBufferSize = 2<<20 uint32 words,
 # src/io/input_split_base.h:39-40)
 DEFAULT_BUFFER_BYTES = (2 << 20) * 4
+
+# telemetry mirrors of the per-instance I/O-shape counters: the same
+# increments feed both the split's io_stats() (per-instance, exact) and
+# these process-global registry series (fleet view via heartbeats);
+# coalescing shows up globally as spans ≪ records, the pread fast path
+# as a flat io.split.seeks
+_REG = _default_registry()
+_SPANS = _REG.counter("io.split.spans", help="positioned reads issued")
+_SEEKS = _REG.counter("io.split.seeks", help="stream seek() calls")
+_BYTES_READ = _REG.counter("io.split.bytes_read", help="bytes read by splits")
+_RECORDS = _REG.counter("io.split.records", help="records emitted by splits")
 
 
 class InputSplit:
@@ -607,6 +619,7 @@ class _SpanReader:
             stream = self._streams[fp]
             stream.seek(rel_off)
             self.seeks += 1
+            _SEEKS.inc()
             while size > 0:
                 data = stream.read(size)
                 if not data:
@@ -924,6 +937,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self.seek_calls += 1
         self.spans_read += 1
         self.bytes_read += size - nleft
+        _SEEKS.inc()
+        _SPANS.inc()
+        _BYTES_READ.inc(size - nleft)
         return b"".join(out)
 
     # -- window-shuffle machinery -------------------------------------------
@@ -977,6 +993,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             parts.append(data)
             self.spans_read += 1
             self.bytes_read += nbytes
+            _SPANS.inc()
+            _BYTES_READ.inc(nbytes)
         buf = np.frombuffer(
             parts[0] if len(parts) == 1 else b"".join(parts),
             dtype=np.uint8,
@@ -1108,6 +1126,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._n_overflow = n - got
             self.records_consumed += got
             self.records_emitted += got
+            _RECORDS.inc(got)
             return chunks[0] if len(chunks) == 1 else b"".join(chunks)
         if self.shuffle_mode == "batch":
             if self._current >= len(self._permutation):
@@ -1125,6 +1144,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             if chunk:
                 self.records_consumed += e - s
                 self.records_emitted += e - s
+                _RECORDS.inc(e - s)
             return chunk if chunk else None
         if self.shuffle:
             n = self._n_overflow or n_records
@@ -1138,6 +1158,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._n_overflow = n - len(parts)
             self.records_consumed += len(parts)
             self.records_emitted += len(parts)
+            _RECORDS.inc(len(parts))
             return b"".join(parts)
         n = self._n_overflow or n_records
         last = min(self._current + n, self.index_end)
@@ -1152,6 +1173,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         if chunk:
             self.records_consumed += last - self._current
             self.records_emitted += last - self._current
+            _RECORDS.inc(last - self._current)
         self._current = last
         return chunk if chunk else None
 
@@ -1315,11 +1337,14 @@ class ThreadedInputSplit(InputSplit):
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
 
-    def io_stats(self) -> Optional[Dict[str, object]]:
+    def io_stats(self) -> Dict[str, object]:
         """Forward the wrapped split's I/O-shape counters (indexed
-        splits), or None when the base doesn't track them."""
+        splits); empty dict when the base doesn't track them — every
+        io_stats() implementation returns a dict (ISSUE 4 satellite:
+        callers assume one)."""
         fn = getattr(self._base, "io_stats", None)
-        return fn() if fn is not None else None
+        out = fn() if fn is not None else None
+        return out if out else {}
 
     def close(self) -> None:
         self._iter.destroy()
@@ -1402,9 +1427,10 @@ class CachedInputSplit(InputSplit):
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
 
-    def io_stats(self) -> Optional[Dict[str, object]]:
+    def io_stats(self) -> Dict[str, object]:
         fn = getattr(self._base, "io_stats", None)
-        return fn() if fn is not None else None
+        out = fn() if fn is not None else None
+        return out if out else {}
 
     def close(self) -> None:
         self._iter.destroy()
@@ -1479,9 +1505,10 @@ class InputSplitShuffle(InputSplit):
     def extract_records(self, chunk: bytes) -> Iterator[bytes]:
         return self._base.extract_records(chunk)
 
-    def io_stats(self) -> Optional[Dict[str, object]]:
+    def io_stats(self) -> Dict[str, object]:
         fn = getattr(self._base, "io_stats", None)
-        return fn() if fn is not None else None
+        out = fn() if fn is not None else None
+        return out if out else {}
 
     def close(self) -> None:
         self._base.close()

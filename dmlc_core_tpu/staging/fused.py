@@ -51,8 +51,23 @@ from ..data import native
 from ..io import split as io_split
 from ..io.filesystem import FileSystem
 from ..io.uri import URISpec, rejoin_query, uri_int
+from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error, check
 from .batcher import Batch, BatchSpec, alloc_packed_slot
+
+# registry mirrors of the per-producer counters (the per-instance
+# attributes stay authoritative for io_stats(); these give the fleet
+# view over heartbeats/scrapes)
+_REG = _default_registry()
+_ROWS_OUT = _REG.counter(
+    "staging.rows_out", help="rows emitted in fixed-shape batches"
+)
+_TRUNCATED = _REG.counter(
+    "staging.truncated_nnz", help="features dropped by fixed-shape overflow"
+)
+_BAD_RECORDS = _REG.counter(
+    "staging.bad_records", help="malformed records skipped by fused parsers"
+)
 
 __all__ = [
     "FusedDenseCSVBatches",
@@ -337,6 +352,7 @@ class _FusedDenseTextBatches(_FusedTextBatches):
     def _emit(self, slot, n_valid: int) -> Batch:
         x, labels, weights, packed = slot
         self.rows_out += n_valid
+        _ROWS_OUT.inc(n_valid)
         if self.spec.overflow == "error" and self.truncated_nnz:
             raise Error(
                 f"{self.truncated_nnz} features outside [0, "
@@ -391,6 +407,8 @@ class FusedDenseLibSVMBatches(_FusedDenseTextBatches):
             chunk, off, self._base or 0, x, labels, weights, fill, cr_hint
         )
         self.truncated_nnz += trunc
+        if trunc:
+            _TRUNCATED.inc(trunc)
         return rows, consumed, cr_hint
 
 
@@ -441,6 +459,8 @@ class FusedDenseCSVBatches(_FusedDenseTextBatches):
             x, labels, weights, fill, cr_hint,
         )
         self.truncated_nnz += trunc
+        if trunc:
+            _TRUNCATED.inc(trunc)
         if bad:
             raise Error(
                 "Delimiter not found in the line. "
@@ -473,6 +493,7 @@ class _EllSlotMixin:
     def _emit_ell(self, slot, n_valid: int) -> Batch:
         indices, values, nnz, labels, weights, packed = slot
         self.rows_out += n_valid
+        _ROWS_OUT.inc(n_valid)
         if self.spec.overflow == "error" and self.truncated_nnz:
             raise Error(
                 f"{self.truncated_nnz} features beyond max_nnz="
@@ -570,9 +591,11 @@ class FusedEllRowRecBatches(_EllSlotMixin):
     def io_stats(self):
         """Counters from the underlying split — seek/span shape on
         indexed shuffled reads, retry/fault deltas on every split-backed
-        path — or None on the mmap fast path."""
+        path — or an empty dict on the mmap fast path (every io_stats()
+        implementation returns a dict, ISSUE 4 satellite)."""
         fn = getattr(self._split, "io_stats", None)
-        return fn() if fn is not None else None
+        out = fn() if fn is not None else None
+        return out if out else {}
 
     def _emit(self, bufs, n_valid: int) -> Batch:
         return self._emit_ell(bufs, n_valid)
@@ -587,6 +610,10 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         self.rows_in += rows
         self.truncated_nnz += trunc
         self.bad_records += bad
+        if trunc:
+            _TRUNCATED.inc(trunc)
+        if bad:
+            _BAD_RECORDS.inc(bad)
         if corrupt:
             # bad magic with a full header in view: the stream is broken
             # HERE — fail fast instead of carrying the rest of the shard
@@ -795,8 +822,8 @@ class ShardedFusedBatches:
 
     def io_stats(self):
         """Summed seek/span counters across sub-producers that track
-        them (numeric fields add; the mode tag carries over), or None
-        when no sub-producer does."""
+        them (numeric fields add; the mode tag carries over), or an
+        empty dict when no sub-producer does."""
         stats = [
             s
             for p in self._producers
@@ -804,7 +831,7 @@ class ShardedFusedBatches:
             if s
         ]
         if not stats:
-            return None
+            return {}
         out: dict = {}
         for s in stats:
             for k, v in s.items():
@@ -1111,14 +1138,16 @@ class _GenericBatchStream:
 
     def io_stats(self):
         """Seek/span counters from the parser's source split (indexed
-        shuffled reads), or None — same hook as the fused producers, so
-        the bench sees the I/O shape whichever path served the rows."""
+        shuffled reads), or an empty dict — same hook as the fused
+        producers, so the bench sees the I/O shape whichever path
+        served the rows."""
         parser = getattr(self._parser, "_base", self._parser)
         source = getattr(
             parser, "source", getattr(parser, "_source", None)
         )
         fn = getattr(source, "io_stats", None)
-        return fn() if fn is not None else None
+        out = fn() if fn is not None else None
+        return out if out else {}
 
     def __iter__(self) -> Iterator[Batch]:
         return self._batcher.batches(iter(self._parser))

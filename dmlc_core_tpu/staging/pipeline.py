@@ -48,6 +48,7 @@ from typing import Any, Dict, Iterable, Iterator, Optional
 import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
+from ..telemetry import default_registry as _default_registry
 from ..utils.profiler import annotate
 from ..utils.timer import get_time
 from .batcher import Batch, packed_shard_layout
@@ -64,6 +65,40 @@ __all__ = [
 logger = logging.getLogger("dmlc_core_tpu.staging")
 
 _PAGE = 4096  # dispatch-ring slot buffers are page-aligned (DMA-friendly)
+
+# telemetry series (docs/observability.md). The per-pipeline
+# ``stage_seconds`` sums stay for r1-r5 bench comparability; the
+# registry carries the same stage timings as log-bucketed duration
+# HISTOGRAMS (one series per stage label), so the tail — a stalled
+# host_pull, one 2-second dispatch — is visible, not averaged away.
+_REG = _default_registry()
+_ROWS_STAGED = _REG.counter("staging.rows", help="rows staged to device")
+_BYTES_STAGED = _REG.counter("staging.bytes", help="bytes staged to device")
+_DEVICE_PUTS = _REG.counter("staging.device_puts", help="device transfers")
+_UNPACK_EVICT = _REG.counter(
+    "staging.unpack_evictions", help="jitted-unpacker LRU evictions"
+)
+
+
+# resolved once: tick_batch runs per staged batch on the transfer
+# thread — it must not pay a registry get-or-create (lock + label-key
+# build) per batch
+_BATCH_COUNTERS = {
+    kind: _REG.counter(
+        "staging.batches",
+        help="staged batches by transfer path",
+        labels={"path": kind},
+    )
+    for kind in ("packed", "packed_shard", "per_array")
+}
+
+
+def _stage_hist(stage: str):
+    return _REG.histogram(
+        "staging.stage_seconds",
+        help="per-stage staging durations (secs)",
+        labels={"stage": stage},
+    )
 
 
 def _require_jax():
@@ -130,6 +165,7 @@ def _cached_unpacker(key, make):
         while len(_UNPACKERS) > cap:
             _UNPACKERS.popitem(last=False)
             _UNPACK_EVICTIONS += 1
+            _UNPACK_EVICT.inc()
     return fn
 
 
@@ -291,19 +327,23 @@ class StagingStats:
         self.packed_shard_dma = False
 
     def tick_puts(self, devices) -> None:
+        n = 0
         with self._lock:
             for d in devices:
+                n += 1
                 self.device_puts += 1
                 key = str(d)
                 self.puts_per_device[key] = (
                     self.puts_per_device.get(key, 0) + 1
                 )
+        _DEVICE_PUTS.inc(n)
 
     def tick_raw_puts(self, n: int) -> None:
         """Count ``n`` transfers not attributed to a specific device
         (per-array fallback paths)."""
         with self._lock:
             self.device_puts += n
+        _DEVICE_PUTS.inc(n)
 
     def tick_batch(self, kind: str) -> None:
         with self._lock:
@@ -313,7 +353,9 @@ class StagingStats:
                 self.packed_shard_batches += 1
                 self.packed_shard_dma = True
             else:
+                kind = "per_array"
                 self.per_array_batches += 1
+        _BATCH_COUNTERS[kind].inc()
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -636,6 +678,19 @@ class StagingPipeline:
             "stage_dispatch": 0.0,
             "transfer_wait": 0.0,
         }
+        # registry duration histograms, one per REAL stage (the derived
+        # stage_dispatch sum is not re-observed — it would double-count
+        # pack+put samples); ISSUE 4: timing splits become histograms
+        self._stage_hists = {
+            k: _stage_hist(k)
+            for k in (
+                "host_pull",
+                "dispatch_pack",
+                "dispatch_put",
+                "dispatch_slot_wait",
+                "transfer_wait",
+            )
+        }
         self.staging = StagingStats()
         # _shard_plan is a once-per-config property (the batcher emits
         # fixed shapes); memoized by shape/dtype signature so the hot
@@ -693,7 +748,17 @@ class StagingPipeline:
             return None
         return plan
 
-    def _next_slot(self, secs) -> _SlotBuf:
+    def _observe(self, key: str, dt: float, dispatch: bool = False) -> None:
+        """One stage timing sample: tick the legacy per-pipeline sum
+        (bench r1-r5 comparability) and the registry duration histogram
+        (``staging.stage_seconds{stage=...}``). ``dispatch`` also feeds
+        the derived ``stage_dispatch`` sum (= pack + put)."""
+        self.stage_seconds[key] += dt
+        if dispatch:
+            self.stage_seconds["stage_dispatch"] += dt
+        self._stage_hists[key].observe(dt)
+
+    def _next_slot(self) -> _SlotBuf:
         """Round-robin slot claim; waits out the slot's previous
         dispatch so the buffer is never rewritten under a live DMA."""
         slot = self._slots[self._slot_i]
@@ -705,20 +770,19 @@ class StagingPipeline:
             except (Exception, CancelledError):
                 pass  # the consumer re-raises from its own future
             slot.pending = None
-            secs["dispatch_slot_wait"] += get_time() - t0
+            self._observe("dispatch_slot_wait", get_time() - t0)
         return slot
 
     def _staged(self) -> Iterator[Any]:
         """Transfer-thread producer: pull host batches, pack into ring
         slots, dispatch on the ring workers, hand future-shaped handles
         to the bounded depth queue."""
-        secs = self.stage_seconds
         jax = self._jax
         while True:
             t0 = get_time()
             with annotate("dmlc:host_pull"):
                 host = self._host_iter.next()
-            secs["host_pull"] += get_time() - t0
+            self._observe("host_pull", get_time() - t0)
             if host is None:
                 return
             platform = self._platform()
@@ -730,16 +794,14 @@ class StagingPipeline:
                 layout = _packed_layout(host)
             if plan is not None:
                 shard_entries, stride, n_shards = plan
-                slot = self._next_slot(secs)
+                slot = self._next_slot()
                 t0 = get_time()
                 with annotate("dmlc:dispatch_pack"):
                     src = _pack_shards(
                         host, shard_entries, stride, n_shards, platform,
                         slot,
                     )
-                dt = get_time() - t0
-                secs["dispatch_pack"] += dt
-                secs["stage_dispatch"] += dt
+                self._observe("dispatch_pack", get_time() - t0, dispatch=True)
                 t0 = get_time()
                 with annotate("dmlc:dispatch_put"):
                     item = self._exec.submit(
@@ -748,17 +810,13 @@ class StagingPipeline:
                     )
                 if platform != "cpu":
                     slot.pending = item
-                dt = get_time() - t0
-                secs["dispatch_put"] += dt
-                secs["stage_dispatch"] += dt
+                self._observe("dispatch_put", get_time() - t0, dispatch=True)
             elif layout is not None:
-                slot = self._next_slot(secs)
+                slot = self._next_slot()
                 t0 = get_time()
                 with annotate("dmlc:dispatch_pack"):
                     src = _pack_single(host, platform, slot)
-                dt = get_time() - t0
-                secs["dispatch_pack"] += dt
-                secs["stage_dispatch"] += dt
+                self._observe("dispatch_pack", get_time() - t0, dispatch=True)
                 t0 = get_time()
                 with annotate("dmlc:dispatch_put"):
                     item = self._exec.submit(
@@ -766,9 +824,7 @@ class StagingPipeline:
                     )
                 if platform != "cpu":
                     slot.pending = item
-                dt = get_time() - t0
-                secs["dispatch_put"] += dt
-                secs["stage_dispatch"] += dt
+                self._observe("dispatch_put", get_time() - t0, dispatch=True)
             else:
                 # per-array fallback: host buffers stay referenced until
                 # the DMA completes, so dispatch stays on this thread and
@@ -792,21 +848,19 @@ class StagingPipeline:
                         self.staging.tick_raw_puts(len(dev))
                         self.staging.tick_batch("per_array")
                     item = _Ready(dev)
-                dt = get_time() - t0
-                secs["dispatch_put"] += dt
-                secs["stage_dispatch"] += dt
+                self._observe("dispatch_put", get_time() - t0, dispatch=True)
             self.rows_staged += host.n_valid
             self.batches_staged += 1
-            self.bytes_staged += sum(
-                v.nbytes for v in host.as_dict().values()
-            )
+            nbytes = sum(v.nbytes for v in host.as_dict().values())
+            self.bytes_staged += nbytes
+            _ROWS_STAGED.inc(host.n_valid)
+            _BYTES_STAGED.inc(nbytes)
             del host  # release the producer slot before blocking downstream
             yield item
 
     def __iter__(self) -> Iterator[Dict[str, Any]]:
         if self._t_start is None:
             self._t_start = get_time()
-        secs = self.stage_seconds
         # the finally tears the threads down when the consumer abandons
         # the iterator (early stop, exception unwind) as well as at
         # normal exhaustion — without it an unclosed pipeline pins
@@ -832,7 +886,7 @@ class StagingPipeline:
                 with annotate("dmlc:transfer_wait"):
                     dev = item.result()
                     self._jax.block_until_ready(dev)
-                secs["transfer_wait"] += get_time() - t0
+                self._observe("transfer_wait", get_time() - t0)
                 yield dev
         finally:
             self.close()

@@ -1,0 +1,53 @@
+"""Unified host-side telemetry (SURVEY §5.1: the reference's only
+observability is timer.h MB/sec log lines; this subsystem replaces the
+ad-hoc counters PRs 1-3 hand-threaded through the stack).
+
+Three layers (docs/observability.md):
+
+- **registry** — process-global ``MetricRegistry`` of ``Counter`` /
+  ``Gauge`` / log-bucketed ``Histogram`` series; thread-sharded
+  lock-free writes, hierarchical names + labels, cardinality cap,
+  ``ScopedView`` counter deltas.
+- **export** — Prometheus text exposition + JSON snapshots + a
+  background interval ``Reporter`` with close-time dump.
+- **aggregate** — tracker-side per-rank/cluster merge of worker
+  heartbeat snapshots, served over a local HTTP ``/metrics`` endpoint
+  and an end-of-job JSON report.
+
+Producers migrated onto it: ``io/retry.py`` (retry/backoff/fault
+counters — ``io_stats()`` stays a bit-compatible view), ``io/split.py``
+(span/seek/byte shape), ``staging/`` (transfer shape + stage-duration
+histograms), ``utils/profiler.annotate`` (opt-in span histograms).
+"""
+
+from .aggregate import ClusterAggregator, merge_snapshots, serve_metrics
+from .export import Reporter, to_json, to_prometheus
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    ScopedView,
+    default_registry,
+    log_bounds,
+    render_key,
+    split_key,
+)
+
+__all__ = [
+    "ClusterAggregator",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "Reporter",
+    "ScopedView",
+    "default_registry",
+    "log_bounds",
+    "merge_snapshots",
+    "render_key",
+    "serve_metrics",
+    "split_key",
+    "to_json",
+    "to_prometheus",
+]

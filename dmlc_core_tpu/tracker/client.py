@@ -13,12 +13,13 @@ DMLC_TRACKER_URI/PORT, DMLC_TASK_ID as the job id for rank recovery.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
-from .protocol import MAGIC, FramedSocket
+from .protocol import CMD_METRICS, MAGIC, FramedSocket
 
 __all__ = ["RabitWorker"]
 
@@ -181,6 +182,31 @@ class RabitWorker:
         reference tracker.py:269-271)."""
         fs = self._connect_tracker("print", self.rank, -1)
         fs.send_str(msg)
+        fs.close()
+
+    def heartbeat(self, metrics: Optional[Dict[str, Any]] = None) -> None:
+        """Piggyback a compact telemetry snapshot on a tracker heartbeat
+        (cmd=metrics). ``metrics`` defaults to the process-global
+        registry snapshot — one call ships every counter/gauge/histogram
+        this worker accumulated; the tracker aggregates per rank and
+        cluster-wide and serves them on its /metrics endpoint
+        (docs/observability.md). Call it from the training loop at
+        whatever cadence suits the job (each epoch is plenty).
+
+        Requires a completed ``start()``: without a rank the tracker
+        would silently drop the frame — fail loudly at the caller
+        instead."""
+        if self.rank < 0:
+            raise RuntimeError(
+                "heartbeat() before start(): this worker has no rank yet, "
+                "so the tracker would discard its metrics"
+            )
+        if metrics is None:
+            from ..telemetry import default_registry
+
+            metrics = default_registry().snapshot()
+        fs = self._connect_tracker(CMD_METRICS, self.rank, -1)
+        fs.send_str(json.dumps(metrics, separators=(",", ":")))
         fs.close()
 
     def shutdown(self) -> None:

@@ -4,6 +4,14 @@ Reference: ExSocket (tracker.py:24-47): native-endian int32 frames and
 length-prefixed strings; magic 0xff99 handshake. Kept bit-compatible so
 rabit-style clients connect unchanged ('<i' == '@i' on every supported
 host; the reference relies on the same).
+
+Commands ride the handshake's length-prefixed cmd string. The reference
+set is {start, recover, shutdown, print}; this rebuild adds
+``CMD_METRICS``: a worker heartbeat carrying ONE length-prefixed JSON
+payload (a compact telemetry registry snapshot — docs/observability.md)
+that the tracker aggregates per rank and cluster-wide. Purely additive:
+a reference tracker that never sees the command is unaffected, and the
+payload reuses the existing string framing (MAX_STR bounds it).
 """
 
 from __future__ import annotations
@@ -13,7 +21,10 @@ import struct
 
 MAGIC = 0xFF99
 
-__all__ = ["MAGIC", "FramedSocket"]
+#: worker → tracker telemetry heartbeat (cmd string on the handshake)
+CMD_METRICS = "metrics"
+
+__all__ = ["CMD_METRICS", "MAGIC", "FramedSocket"]
 
 
 class FramedSocket:

@@ -20,7 +20,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from .protocol import MAGIC, FramedSocket
+from ..telemetry import ClusterAggregator, serve_metrics
+from .protocol import CMD_METRICS, MAGIC, FramedSocket
 from .topology import get_link_map
 
 __all__ = [
@@ -81,7 +82,9 @@ class WorkerEntry:
         self.cmd = self.sock.recv_str()
         self.wait_accept = 0
         self.port: Optional[int] = None
-        self.print_msg: Optional[str] = None  # filled for cmd == 'print'
+        #: filled for cmd == 'print' (log line) / cmd == 'metrics'
+        #: (JSON telemetry snapshot) — the two one-payload commands
+        self.print_msg: Optional[str] = None
 
     def decide_rank(self, job_map: Dict[str, int]) -> int:
         if self.rank >= 0:
@@ -323,6 +326,14 @@ class RabitTracker:
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         self.messages: List[str] = []  # relayed worker 'print' logs
+        # telemetry: per-rank heartbeat snapshots aggregated cluster-wide
+        # (docs/observability.md); served over a loopback HTTP /metrics
+        # endpoint while the job runs, dumped as a JSON report at end of
+        # job (DMLC_METRICS_REPORT=<path>)
+        self.metrics = ClusterAggregator()
+        self.metrics_report: Optional[Dict[str, object]] = None
+        self.metrics_port: Optional[int] = None
+        self._metrics_server = None
         logger.info("start listen on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
@@ -353,8 +364,8 @@ class RabitTracker:
         slow-loris client burns only this thread's timeout."""
         try:
             entry = WorkerEntry(conn, addr)
-            if entry.cmd == "print":
-                # read the relayed message here too — it is the other
+            if entry.cmd in ("print", CMD_METRICS):
+                # read the one-string payload here too — it is the other
                 # blocking recv a hostile client could stall on
                 entry.print_msg = entry.sock.recv_str()
         except (ConnectionError, OSError) as e:
@@ -453,7 +464,7 @@ class RabitTracker:
                     logger.info(
                         "@tracker all of %d nodes are started", n_workers
                     )
-                    self.start_time = time.time()
+                    self.start_time = time.time()  # noqa: L008 (wall-clock job timestamp, not a duration measurement)
                 continue
             # Any protocol violation (or a socket dying mid-exchange) drops
             # THIS connection; the state machine must keep serving the rest
@@ -463,6 +474,18 @@ class RabitTracker:
                     msg = entry.print_msg or ""
                     self.messages.append(msg.strip())
                     logger.info("%s", msg.strip())
+                    continue
+                if entry.cmd == CMD_METRICS:
+                    # same bound as shutdown: a fabricated out-of-range
+                    # rank must not mint unbounded per-rank snapshots
+                    # (~MAX_STR each) or pollute the aggregate
+                    check_proto(
+                        0 <= entry.rank < n_workers,
+                        f"metrics heartbeat from invalid rank "
+                        f"{entry.rank}",
+                    )
+                    # aggregator validates/drops malformed payloads
+                    self.metrics.update(entry.rank, entry.print_msg or "")
                     continue
                 if entry.cmd == "shutdown":
                     check_proto(
@@ -592,14 +615,55 @@ class RabitTracker:
                 )
                 entry.sock.close()
         logger.info("@tracker all nodes finished the job")
-        self.end_time = time.time()
+        self.end_time = time.time()  # noqa: L008 (wall-clock job timestamp, not a duration measurement)
         if self.start_time is not None:
             logger.info(
                 "@tracker %.3f secs between node start and job finish",
                 self.end_time - self.start_time,
             )
+        self._finish_metrics_report()
+
+    def _finish_metrics_report(self) -> None:
+        """End-of-job telemetry dump: the aggregated per-rank + cluster
+        report is kept on ``self.metrics_report`` and, when
+        ``DMLC_METRICS_REPORT`` names a path, written there as JSON."""
+        if self.metrics.updates == 0:
+            return
+        import json
+
+        try:
+            self.metrics_report = self.metrics.report()
+        except Exception:
+            # a failed report must never kill the state thread at the
+            # finish line (heartbeat payloads are sanitized, but the
+            # job's completion does not ride on its telemetry)
+            logger.exception("telemetry report aggregation failed")
+            return
+        path = os.environ.get("DMLC_METRICS_REPORT")
+        if path:
+            try:
+                with open(path, "w") as f:
+                    json.dump(self.metrics_report, f)
+                logger.info("@tracker telemetry report written to %s", path)
+            except OSError as e:
+                logger.warning("telemetry report write failed: %s", e)
 
     def start(self, n_workers: Optional[int] = None) -> None:
+        # loopback telemetry endpoint (GET /metrics = Prometheus text,
+        # /metrics.json = full report); DMLC_METRICS_HTTP=0 disables,
+        # DMLC_METRICS_PORT pins the port (default: ephemeral)
+        if os.environ.get("DMLC_METRICS_HTTP", "1") not in ("0", "false"):
+            try:
+                port = int(os.environ.get("DMLC_METRICS_PORT", "0"))
+                self._metrics_server, self.metrics_port = serve_metrics(
+                    self.metrics, port=port
+                )
+                logger.info(
+                    "telemetry endpoint on 127.0.0.1:%d/metrics",
+                    self.metrics_port,
+                )
+            except (OSError, ValueError) as e:
+                logger.warning("telemetry endpoint disabled: %s", e)
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rabit-accept",
         )
@@ -624,6 +688,14 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            # shutdown() only stops the serve loop; the bound listen
+            # socket must be closed too or a relaunch with a pinned
+            # DMLC_METRICS_PORT hits EADDRINUSE (and each stop leaks
+            # an fd)
+            self._metrics_server.server_close()
+            self._metrics_server = None
         # the state thread blocks on its event queue, not on accept():
         # closing the socket alone no longer terminates it
         self._events.put(("stop", None, None, None))

@@ -12,6 +12,12 @@ without making jax a hard dependency of the data layer:
 - ``trace(logdir)``: context manager around
   ``jax.profiler.start_trace/stop_trace`` — wrap any region (e.g. a
   bench epoch) and open the logdir with XProf/TensorBoard.
+- span → telemetry bridge (ISSUE 4): with ``DMLC_PROFILE_HIST=1`` (or
+  ``enable_histograms(True)``), every ``annotate`` span also records its
+  duration into the registry histogram
+  ``profiler.span_seconds{span=<name>}`` — XProf shows one trace,
+  telemetry keeps the distribution across the whole run. Off by
+  default: the hot loop pays nothing beyond the existing annotation.
 
 StagingPipeline wires ``annotate`` around its pull/stage/wait phases, so
 a trace of a training loop shows exactly where infeed time goes
@@ -20,9 +26,12 @@ a trace of a training loop shows exactly where infeed time goes
 
 from __future__ import annotations
 
+import os
+import time
 from contextlib import contextmanager, nullcontext
+from typing import Dict, Optional
 
-__all__ = ["annotate", "trace"]
+__all__ = ["annotate", "enable_histograms", "histograms_enabled", "trace"]
 
 
 _PROF = False  # unresolved sentinel; None = jax absent
@@ -40,13 +49,80 @@ def _jax_profiler():
     return _PROF
 
 
+# -- span duration histograms (opt-in) ----------------------------------------
+
+_HIST_OVERRIDE: Optional[bool] = None  # enable_histograms() wins over env
+_SPAN_HISTS: Dict[str, object] = {}  # name -> Histogram (memoized lookup)
+
+
+def histograms_enabled() -> bool:
+    """Are annotate() spans feeding duration histograms?"""
+    if _HIST_OVERRIDE is not None:
+        return _HIST_OVERRIDE
+    return os.environ.get("DMLC_PROFILE_HIST", "0") not in ("", "0", "false")
+
+
+def enable_histograms(on: Optional[bool]) -> None:
+    """Force span histograms on/off for this process (None restores the
+    ``DMLC_PROFILE_HIST`` env default)."""
+    global _HIST_OVERRIDE
+    _HIST_OVERRIDE = on
+
+
+_SPAN_MEMO_CAP = 256  # span names are static call sites, not data
+
+
+def _span_hist(name: str):
+    hist = _SPAN_HISTS.get(name)
+    if hist is None:
+        from ..telemetry import default_registry  # deferred: cold path only
+
+        hist = default_registry().histogram(
+            "profiler.span_seconds",
+            help="annotate() span durations (secs)",
+            labels={"span": name},
+        )
+        # the memo exists to skip the registry lock per span; dynamic
+        # names (annotate(f"step_{i}")) must not grow it forever — past
+        # the cap, fall through to the registry each call (whose own
+        # cardinality cap collapses the series)
+        if len(_SPAN_HISTS) < _SPAN_MEMO_CAP:
+            _SPAN_HISTS[name] = hist
+    return hist
+
+
+class _TimedSpan:
+    """annotate() with histograms on: enter the inner annotation (if
+    any), time the region with perf_counter, observe on exit."""
+
+    __slots__ = ("_inner", "_hist", "_t0")
+
+    def __init__(self, inner, hist) -> None:
+        self._inner = inner
+        self._hist = hist
+
+    def __enter__(self):
+        if self._inner is not None:
+            self._inner.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        if self._inner is not None:
+            return self._inner.__exit__(*exc)
+        return False
+
+
 def annotate(name: str):
     """Context manager marking a host-side span on the XProf timeline
-    (no-op without jax)."""
+    (no-op without jax); records the span duration into
+    ``profiler.span_seconds{span=name}`` when histograms are enabled."""
     prof = _jax_profiler()
-    if prof is None:
-        return nullcontext()
-    return prof.TraceAnnotation(name)
+    inner = prof.TraceAnnotation(name) if prof is not None else None
+    if histograms_enabled():
+        return _TimedSpan(inner, _span_hist(name))
+    return inner if inner is not None else nullcontext()
 
 
 @contextmanager

@@ -137,6 +137,54 @@ def test_device_put_quiet_in_staging_layer(tmp_path):
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
 
 
+def _lib_findings(src, tmp_path, name="mod.py"):
+    """Findings for a file living under a dmlc_core_tpu/ tree (the L008
+    scope — the rule must not fire outside the library)."""
+    d = tmp_path / "dmlc_core_tpu" / "io"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(src)
+    return [(code, line) for (_, line, code, _) in lint.lint_file(f)]
+
+
+def test_wall_clock_time_flagged_in_library(tmp_path):
+    src = "import time\nt0 = time.time()\n"
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L008"]
+    # a bare `time()` bound by from-import does not dodge the rule
+    src = "from time import time\nt0 = time()\n"
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L008"]
+    # ...nor does an alias
+    src = "from time import time as now\nt0 = now()\n"
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L008"]
+    # ...nor does aliasing the MODULE (the repo's `import time as _time`
+    # idiom must not become an escape hatch)
+    src = "import time as _time\nt0 = _time.time()\n"
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L008"]
+
+
+def test_wall_clock_time_quiet_on_sanctioned_uses(tmp_path):
+    # the sanctioned clocks are quiet
+    src = (
+        "import time\n"
+        "t0 = time.perf_counter()\n"
+        "t1 = time.monotonic()\n"
+        "time.sleep(0.1)\n"
+    )
+    assert _lib_findings(src, tmp_path) == []
+    # per-line opt-out for genuine wall-clock sites (token expiry, JWT)
+    src = "import time\nexp = time.time()  # noqa: L008 (token expiry)\n"
+    assert _lib_findings(src, tmp_path) == []
+    # unrelated .time() attribute calls (datetime.time etc.) are not ours
+    src = "import datetime\nd = datetime.datetime.now().time()\n"
+    assert _lib_findings(src, tmp_path) == []
+
+
+def test_wall_clock_time_unscoped_outside_library(tmp_path):
+    """L008 is scoped to dmlc_core_tpu/: benches/tests/tools measuring
+    with wall-clock on purpose are not the library's business."""
+    assert codes("import time\nt0 = time.time()\n", tmp_path) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -22,6 +22,14 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  and link probes opt out per line with
                                  `# noqa: L007`. Non-batch placements go
                                  through staging.device_put.)
+  L008 time.time() in dmlc_core_tpu/ (durations measured with the wall
+                                 clock go backwards under NTP slew; use
+                                 time.perf_counter()/monotonic() — the
+                                 telemetry histograms assume it. Genuine
+                                 wall-clock sites — token/JWT expiry in
+                                 io/cloudfs.py, job timestamps in
+                                 tracker/tracker.py — opt out per line
+                                 with `# noqa: L008`.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -208,6 +216,42 @@ def _check_direct_device_put(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+def _check_wall_clock_time(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call resolving to ``time.time``: the ``<mod>.time(...)``
+    attribute call where ``<mod>`` is the time module under any name
+    (``import time`` / ``import time as t``) and a bare ``time(...)``
+    bound by ``from time import time`` (with or without an alias).
+    Scoped to dmlc_core_tpu/ (see lint_file): library code measuring
+    durations must use perf_counter/monotonic; legitimate wall-clock
+    reads opt out per line with ``# noqa: L008``."""
+    fn_aliases = set()
+    mod_aliases = {"time"}  # names the time MODULE is bound to
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    mod_aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+            isinstance(f, ast.Attribute)
+            and f.attr == "time"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mod_aliases
+        )
+        if hit:
+            yield node.lineno, (
+                "time.time() for measurement (use time.perf_counter()/"
+                "monotonic(); wall-clock sites opt out with noqa: L008)"
+            )
+
+
 # files allowed to call urlopen directly: the retry layer itself (the
 # leading '/' anchors the path segment — audio/retry.py is NOT exempt)
 _L006_EXEMPT = ("/io/retry.py",)
@@ -218,6 +262,10 @@ _L006_EXEMPT = ("/io/retry.py",)
 # repo (lint_file called on scratch dirs, as the lint's own tests do)
 # fall back to an absolute-path segment match.
 _L007_EXEMPT_DIRS = ("dmlc_core_tpu/staging/", "tests/")
+# L008 is SCOPED (not exempted): it only applies to library code under
+# dmlc_core_tpu/ — benches and tests measure with perf_counter already,
+# and scripts outside the library may legitimately want wall-clock
+_L008_SCOPE_DIRS = ("dmlc_core_tpu/",)
 
 CHECKS = [
     ("L001", _check_unused_imports),
@@ -227,6 +275,7 @@ CHECKS = [
     ("L005", _check_duplicate_dict_keys),
     ("L006", _check_direct_urlopen),
     ("L007", _check_direct_device_put),
+    ("L008", _check_wall_clock_time),
 ]
 
 
@@ -255,6 +304,12 @@ def lint_file(path: Path) -> List[Finding]:
             rel_posix.startswith(_L007_EXEMPT_DIRS)
             if in_repo
             else any("/" + d in posix for d in _L007_EXEMPT_DIRS)
+        ):
+            continue
+        if code == "L008" and not (
+            rel_posix.startswith(_L008_SCOPE_DIRS)
+            if in_repo
+            else any("/" + d in posix for d in _L008_SCOPE_DIRS)
         ):
             continue
         for line, msg in fn(tree):
