@@ -339,6 +339,68 @@ def _make_rec_stream(value_dtype: str):
 
 
 REC_INDEX = REC_DATA + ".idx"
+REC_ZLIB_DATA = os.environ.get(
+    "BENCH_REC_ZLIB_DATA", f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.zlib.rec"
+)
+REC_ZLIB_INDEX = REC_ZLIB_DATA + ".idx"
+
+
+def ensure_rec_zlib_data() -> None:
+    """zlib-compressed-block copy of the bench .rec (+ block index):
+    the codec-path config (`rec_zlib`) tracks decode throughput and
+    compression_ratio round over round. Conversion feeds the uniform-
+    stride frames to write_framed_block in bulk (arithmetic offsets, no
+    per-record re-framing) — one pass, compression is the only cost."""
+    if (os.path.exists(REC_ZLIB_DATA) and os.path.getsize(REC_ZLIB_DATA) > 0
+            and os.path.exists(REC_ZLIB_INDEX)
+            and os.path.getsize(REC_ZLIB_INDEX) > 0):
+        return
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream
+
+    stride = 8 + 12 + REC_K * 8  # frame header + payload (ensure_rec_data)
+    tmp, tmpi = REC_ZLIB_DATA + ".tmp", REC_ZLIB_INDEX + ".tmp"
+    with open(REC_DATA, "rb") as src, FileStream(tmp, "w") as f, FileStream(
+        tmpi, "w"
+    ) as fi:
+        w = IndexedRecordIOWriter(f, fi, codec="zlib")
+        while True:
+            buf = src.read(stride * 4096)
+            if not buf:
+                break
+            n = len(buf) // stride
+            assert n * stride == len(buf), "bench .rec is not stride-uniform"
+            w.write_framed_block(
+                buf, np.arange(n, dtype=np.int64) * stride
+            )
+        w.flush_block()
+    os.replace(tmp, REC_ZLIB_DATA)
+    os.replace(tmpi, REC_ZLIB_INDEX)
+
+
+def _make_rec_zlib_stream(value_dtype: str):
+    """Compressed-block RecordIO → fused ELL staging: chunks decode on
+    the codec layer (parallel block decompress) before the native frame
+    scan, so the whole fused path rides unchanged. data_path is the
+    UNCOMPRESSED .rec — mb_per_sec is then effective DECODED MB/s, the
+    number the codec must beat when the link (not the CPU) is the
+    bottleneck."""
+    from dmlc_core_tpu.staging import BatchSpec, ell_batches
+
+    spec = BatchSpec(
+        batch_size=BATCH,
+        layout="ell",
+        max_nnz=REC_K,
+        value_dtype=np.dtype(value_dtype),
+    )
+    return (
+        ell_batches(
+            _fault_wrapped(REC_ZLIB_DATA), spec,
+            nthread=_nthread_for(REC_ROWS), ring=_RING,
+        ),
+        "values",
+        REC_DATA,
+    )
 
 
 def ensure_rec_index() -> None:
@@ -675,13 +737,19 @@ def run_series(tasks, rounds: int, probe: "LinkProbe"):
     SPREAD across the early and late link/throttle windows (a +1 stride
     would leave late-listed tasks always late) — fixed-order runs
     confounded dtype cost with throttle onset in r3 (VERDICT r3 #6).
-    A link probe runs before every task; its reading is attached to the
-    task's result as ``link_before``. Returns {name: [result, ...]}."""
+    Each config is WARMED before its probe samples: a discarded warmup
+    transfer runs first, so the sampled probe reads post-warm link
+    state instead of whatever cold/burst window the previous task left
+    behind — BENCH_r05's 27.9x min/median link_probe spread was mostly
+    that unwarmed first-touch, drowning real regressions. The sampled
+    probe reading is attached to the task's result as ``link_before``.
+    Returns {name: [result, ...]}."""
     results = {name: [] for name, _fn in tasks}
     for r in range(rounds):
         off = (r * len(tasks)) // max(rounds, 1) % len(tasks)
         order = tasks[off:] + tasks[:off]
         for name, fn in order:
+            probe.measure("warmup")  # discarded: warms the link state
             link = probe.measure(name)
             res = fn()
             res["link_before"] = round(link, 1)
@@ -695,11 +763,37 @@ def _telemetry_snapshot() -> dict:
     return to_json()
 
 
+def _codec_summary() -> dict:
+    """Codec-path numbers for the perf trajectory: the compression
+    ratio actually moved through the codec layer this run (bytes_raw /
+    bytes_compressed — encode at data-gen time and decode during the
+    rec_zlib epochs tick the same counters with the same ratio) and the
+    per-block decode-time percentiles from the
+    io.codec.decode_seconds histogram."""
+    from dmlc_core_tpu.telemetry import default_registry
+
+    reg = default_registry()
+    raw_b = reg.counter("io.codec.bytes_raw").value()
+    comp_b = reg.counter("io.codec.bytes_compressed").value()
+    hist = reg.histogram("io.codec.decode_seconds").snapshot()
+    return {
+        "compression_ratio": (
+            round(raw_b / comp_b, 4) if comp_b else None
+        ),
+        "codec_decode_seconds": {
+            k: hist[k]
+            for k in ("count", "p50", "p90", "p99")
+            if k in hist
+        },
+    }
+
+
 def main() -> None:
     ensure_native()
     ensure_data()
     ensure_rec_data()
     ensure_rec_index()
+    ensure_rec_zlib_data()
     ensure_csv_data()
     ensure_libfm_data()
     ensure_libsvm_sparse_data()
@@ -723,6 +817,8 @@ def main() -> None:
          lambda: run_epoch(_make_rec_shuffled_stream("batch"), "float16")),
         ("rec_shuffled_window",
          lambda: run_epoch(_make_rec_shuffled_stream("window"), "float16")),
+        ("rec_zlib",
+         lambda: run_epoch(_make_rec_zlib_stream, "float16")),
     ]
     # probe buffer ≈ the rec f16 packed batch (indices i32 + values f16
     # + label/weight f32, 8-byte aligned sections)
@@ -825,6 +921,16 @@ def main() -> None:
                 "recordio_shuffled_window_rows_per_sec": med(
                     "rec_shuffled_window"
                 ),
+                # codec path: rows/s through zlib-compressed blocks and
+                # the effective DECODED MB/s (scored against the
+                # uncompressed .rec size — the codec wins whenever the
+                # link, not the CPU, is the bottleneck), plus the
+                # ratio/percentiles from the io.codec.* telemetry
+                "recordio_zlib_rows_per_sec": med("rec_zlib"),
+                "recordio_zlib_decoded_mb_per_sec": med(
+                    "rec_zlib", "mb_per_sec"
+                ),
+                **_codec_summary(),
                 # window/record speedup is THE tentpole acceptance
                 # number (ISSUE 1: >= 5x on the same host); the io
                 # shapes prove WHY — spans ≪ records under coalescing,

@@ -48,11 +48,16 @@ def build_info() -> dict:
     import os
 
     from .data import native
+    from .io.codec import available_codecs
 
     info = {
         "version": __version__,
         "native_available": native.AVAILABLE,
         "native_source_hash": native.source_hash(),
+        # compression codecs this host can decode (io/codec.py): a
+        # deploy target can be checked remotely before shipping it
+        # zstd/lz4-compressed shards
+        "codecs": available_codecs(),
         "fused_kernels": {
             "libsvm_dense": native.HAS_DENSE,
             "csv_dense": native.HAS_CSV_DENSE,
@@ -65,6 +70,8 @@ def build_info() -> dict:
             for k in (
                 "DMLC_TPU_NO_NATIVE",
                 "DMLC_TPU_PARSER_THREADS",
+                "DMLC_DECODE_CACHE_MB",
+                "DMLC_DECODE_THREADS",
                 "DMLC_LOG_DEBUG",
                 "DMLC_MAX_ATTEMPT",
                 "DMLC_RENDEZVOUS_GRACE",

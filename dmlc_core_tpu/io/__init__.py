@@ -21,12 +21,21 @@ from .filesystem import (  # noqa: F401
     TemporaryDirectory,
     FS_REGISTRY,
 )
+from . import codec  # noqa: F401 — the single compression site (L009)
+from .codec import (  # noqa: F401
+    DecodedBlockCache,
+    available_codecs,
+    default_decode_cache,
+    get_codec,
+)
 from .recordio import (  # noqa: F401
     KMAGIC,
+    CFLAG_COMPRESSED,
     RecordIOWriter,
     IndexedRecordIOWriter,
     RecordIOReader,
     RecordIOChunkReader,
+    decode_chunk,
 )
 from . import serializer  # noqa: F401
 from . import retry  # noqa: F401

@@ -29,13 +29,16 @@ import numpy as np
 from ..concurrency.threaded_iter import ThreadedIter
 from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error, check, check_eq
+from . import codec as _codec
 from . import retry as _retry
 from . import serializer
 from .filesystem import FileInfo, FileSystem
 from .recordio import (
     RecordIOChunkReader,
+    decode_chunk,
     first_head_in_words,
     last_head_in_words,
+    scan_compressed_blob,
 )
 from .stream import SeekStream, Stream
 from .uri import URISpec, uri_int
@@ -456,10 +459,25 @@ def _find_newline(buf: bytes) -> int:
 
 class RecordIOSplitter(InputSplitBase):
     """record = RecordIO frame (reference src/io/recordio_split.{h,cc});
-    align=4."""
+    align=4.
+
+    Compressed-block-aware: chunks are decoded (io/recordio.decode_chunk
+    — one vectorized detection pass for v1 files, parallel per-block
+    decompression for compressed ones) before leaving ``next_chunk``,
+    so every downstream consumer — extract_records, the fused native
+    kernels, RowRecParser, the staging layer — sees pure v1 frames and
+    works on compressed files unchanged. Byte-range sharding still
+    snaps to heads via the magic scan (compressed blocks are heads with
+    their reserved cflags), and a block is atomic to one shard."""
 
     _align = 4
     _is_text = False
+
+    def _next_chunk_ex(self) -> Optional[bytes]:
+        chunk = super()._next_chunk_ex()
+        if chunk is None:
+            return None
+        return decode_chunk(chunk)
 
     def seek_record_begin(self, stream: Stream) -> int:
         """Scan forward for a record head (reference recordio_split.cc:9-25),
@@ -766,6 +784,17 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._index: List[Tuple[int, int]] = []  # (offset, size)
         self._index_offs = np.empty(0, dtype=np.int64)
         self._index_sizes = np.empty(0, dtype=np.int64)
+        # compressed-block geometry (set by _read_index_file when the
+        # sidecar carries block:in-offset pairs — docs/recordio.md)
+        self._compressed = False
+        self._rec_block = np.empty(0, dtype=np.int64)  # block id per record
+        self._rec_inoff = np.empty(0, dtype=np.int64)  # offset in decoded blk
+        self._rec_next = np.empty(0, dtype=np.int64)  # next rec's inoff | -1
+        self._block_offs = np.empty(0, dtype=np.int64)  # block file offsets
+        self._block_sizes = np.empty(0, dtype=np.int64)  # on-disk framed size
+        self._cache_key: object = None
+        self.decode_cache_hits = 0
+        self.decode_cache_misses = 0
         self._index_uri = index_uri
         self.index_begin = 0
         self.index_end = 0
@@ -778,10 +807,19 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         stream = Stream.create(self._index_uri, "r")
         with stream:
             text = stream.read().decode()
-        offsets = sorted(int(tok) for i, tok in enumerate(text.split()) if i % 2 == 1)
-        if not offsets:
+        vals = text.split()[1::2]
+        if not vals:
             raise Error(f"empty index file {self._index_uri!r}")
         total = self.file_offset[-1]
+        if any(":" in t for t in vals):
+            check(
+                all(":" in t for t in vals),
+                f"index file {self._index_uri!r} mixes v1 and "
+                f"compressed-block offsets",
+            )
+            self._read_compressed_index(vals, total)
+            return
+        offsets = sorted(int(tok) for tok in vals)
         self._index = [
             (offsets[i], (offsets[i + 1] if i + 1 < len(offsets) else total) - offsets[i])
             for i in range(len(offsets))
@@ -792,6 +830,64 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._index_sizes = np.concatenate(
             (np.diff(self._index_offs), [total - offsets[-1]])
         ).astype(np.int64)
+
+    def _read_compressed_index(self, vals: List[str], total: int) -> None:
+        """Compressed sidecar: ``key  <block>:<in>`` per record — the
+        block frame's file offset and the record's frame start inside
+        the DECODED block. Records sort by (block, in-offset), i.e.
+        file order, matching the v1 offset sort."""
+        pairs = sorted(
+            (int(a), int(b)) for a, _, b in (t.partition(":") for t in vals)
+        )
+        self._compressed = True
+        rec_boff = np.asarray([p[0] for p in pairs], dtype=np.int64)
+        self._rec_inoff = np.asarray([p[1] for p in pairs], dtype=np.int64)
+        boffs, inv = np.unique(rec_boff, return_inverse=True)
+        self._block_offs = boffs
+        self._rec_block = inv.astype(np.int64)
+        self._block_sizes = np.concatenate(
+            (np.diff(boffs), [total - int(boffs[-1])])
+        ).astype(np.int64)
+        check(
+            bool((self._block_sizes > 0).all()) and int(boffs[0]) >= 0,
+            f"index file {self._index_uri!r}: block offsets outside the "
+            f"{total}-byte dataset",
+        )
+        # next record's in-block offset within the same block; -1 = the
+        # block's last record (slice runs to the decoded end)
+        nxt = np.full(len(pairs), -1, dtype=np.int64)
+        same = self._rec_block[1:] == self._rec_block[:-1]
+        nxt[:-1][same] = self._rec_inoff[1:][same]
+        self._rec_next = nxt
+        # decoded-block cache identity: per-file (path, size, local
+        # mtime_ns) + total size + block layout + (per lookup) the
+        # block's file offset. The mtime term makes an IN-PLACE rewrite
+        # of a local file a different cache identity even when the new
+        # content reproduces the exact block geometry; remote backends
+        # (no cheap mtime) fall back to path+size+layout identity.
+        sig = []
+        for f in self.files:
+            path = f.path
+            local = (
+                path[len("file://"):]
+                if path.startswith("file://")
+                else (None if "://" in path else path)
+            )
+            mtime = 0
+            if local is not None:
+                try:
+                    mtime = os.stat(local).st_mtime_ns
+                except OSError:
+                    pass
+            sig.append((path, int(f.size), mtime))
+        self._cache_key = (tuple(sig), int(total), hash(boffs.tobytes()))
+        # byte-offset anchors: a record 'sits at' its block's file
+        # offset, which keeps reset_partition's offset_begin/offset_end
+        # bookkeeping meaningful (sizes are a compressed-path no-op)
+        anchor = boffs[self._rec_block]
+        self._index = [(int(a), 0) for a in anchor.tolist()]
+        self._index_offs = anchor
+        self._index_sizes = np.zeros(len(pairs), dtype=np.int64)
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         """Record-count range (reference indexed_recordio_split.cc:12-41)."""
@@ -942,6 +1038,122 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         _BYTES_READ.inc(size - nleft)
         return b"".join(out)
 
+    # -- compressed-block machinery ------------------------------------------
+    def _decoded_block(self, bid: int) -> bytes:
+        """Decoded raw framed bytes of block ``bid``, through the
+        process-global decoded-block cache (io/codec.py,
+        DMLC_DECODE_CACHE_MB) — multi-epoch and shuffled reads decode
+        each block once while it stays resident."""
+        off = int(self._block_offs[bid])
+        cache = _codec.default_decode_cache()
+        data = cache.get((self._cache_key, off))
+        if data is not None:
+            self.decode_cache_hits += 1
+            return data
+        self.decode_cache_misses += 1
+        framed = self._read_at(off, int(self._block_sizes[bid]))
+        blob, _end = scan_compressed_blob(memoryview(framed), 0)
+        raw, _n = _codec.decode_block(blob)
+        cache.put((self._cache_key, off), raw)
+        return raw
+
+    def _emit_range(self, lo: int, hi: int) -> bytes:
+        """Framed v1 bytes of records [lo, hi) of a compressed file:
+        decode each covered block (cache-served), slice by the index's
+        in-block offsets. Output is byte-identical to the uncompressed
+        writer's framing for the same records."""
+        out: List[bytes] = []
+        i = lo
+        while i < hi:
+            b = int(self._rec_block[i])
+            j = i + 1
+            while j < hi and int(self._rec_block[j]) == b:
+                j += 1
+            raw = self._decoded_block(b)
+            start = int(self._rec_inoff[i])
+            end = int(self._rec_next[j - 1])
+            out.append(raw[start:] if end < 0 else raw[start:end])
+            i = j
+        return b"".join(out)
+
+    def _load_window_compressed(
+        self, perm: np.ndarray
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Window shuffle over compressed blocks: span coalescing is
+        valid at BLOCK granularity — the window's unique blocks are
+        read via coalesced file spans (merge_gap bytes of waste bound),
+        decompressed in parallel on the shared codec pool (overlapped
+        with the consumer by the window readahead thread), and served
+        from the decoded-block cache. The emission buffer concatenates
+        decoded blocks; per-record (start, size) come from the index's
+        in-block offsets, in permutation order."""
+        bids = self._rec_block[perm]
+        uniq = np.unique(bids)
+        cache = _codec.default_decode_cache()
+        decoded: Dict[int, bytes] = {}
+        missing: List[int] = []
+        for b in uniq.tolist():
+            data = cache.get((self._cache_key, int(self._block_offs[b])))
+            if data is None:
+                missing.append(b)
+            else:
+                self.decode_cache_hits += 1
+                decoded[b] = data
+        self.decode_cache_misses += len(missing)
+        if missing:
+            if self._span_reader is None:
+                self._span_reader = _SpanReader(
+                    self.files, self.file_offset, self.filesys
+                )
+            marr = np.asarray(missing, dtype=np.int64)
+            offs = self._block_offs[marr]
+            sizes = self._block_sizes[marr]
+            order, starts, ends = _plan_span_bounds(
+                offs, sizes, self.merge_gap
+            )
+            span_begin = offs[order][starts]
+            run_end = np.maximum.accumulate(offs[order] + sizes[order])
+            span_len = run_end[ends - 1] - span_begin
+            blobs: List[bytes] = []
+            blob_bid: List[int] = []
+            for si, (begin, nbytes) in enumerate(
+                zip(span_begin.tolist(), span_len.tolist())
+            ):
+                data = self._span_reader.read(begin, nbytes)
+                check_eq(len(data), nbytes, "span read truncated")
+                self.spans_read += 1
+                self.bytes_read += nbytes
+                _SPANS.inc()
+                _BYTES_READ.inc(nbytes)
+                mv = memoryview(data)
+                for k in order[starts[si] : ends[si]].tolist():
+                    rel = int(offs[k]) - begin
+                    blob, _end = scan_compressed_blob(
+                        mv[rel : rel + int(sizes[k])], 0
+                    )
+                    blobs.append(blob)
+                    blob_bid.append(int(marr[k]))
+            for b, (raw, _n) in zip(blob_bid, _codec.decode_blocks(blobs)):
+                decoded[b] = raw
+                cache.put((self._cache_key, int(self._block_offs[b])), raw)
+        lens = np.asarray(
+            [len(decoded[b]) for b in uniq.tolist()], dtype=np.int64
+        )
+        base = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        buf = np.frombuffer(
+            b"".join(decoded[b] for b in uniq.tolist()), dtype=np.uint8
+        )
+        pos = np.searchsorted(uniq, bids)
+        rec_start = base[pos] + self._rec_inoff[perm]
+        nxt = self._rec_next[perm]
+        rec_end = base[pos] + np.where(nxt >= 0, nxt, lens[pos])
+        idt = np.int32 if len(buf) < (1 << 31) else np.int64
+        return (
+            buf,
+            rec_start.astype(idt),
+            (rec_end - rec_start).astype(idt),
+        )
+
     # -- window-shuffle machinery -------------------------------------------
     def _n_windows(self) -> int:
         return -(-len(self._permutation) // self.window)
@@ -972,6 +1184,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         perm = np.asarray(
             self._permutation[k * W : (k + 1) * W], dtype=np.int64
         )
+        if self._compressed:
+            return self._load_window_compressed(perm)
         offs = self._index_offs[perm]
         sizes = self._index_sizes[perm]
         order, starts, ends = _plan_span_bounds(
@@ -1103,7 +1317,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         seeks = self.seek_calls
         if self._span_reader is not None:
             seeks += self._span_reader.seeks
-        return {
+        out = {
             "mode": self.shuffle_mode or "sequential",
             "records": self.records_emitted,
             "spans": self.spans_read,
@@ -1111,6 +1325,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             "bytes_read": self.bytes_read,
             **_retry.stats_delta(self._retry_snap),
         }
+        if self._compressed:
+            # decoded-block cache shape: hits ≫ misses on a second epoch
+            # proves each block decompressed once (DMLC_DECODE_CACHE_MB)
+            out["decode_cache_hits"] = self.decode_cache_hits
+            out["decode_cache_misses"] = self.decode_cache_misses
+        return out
 
     def next_batch_ex(self, n_records: int) -> Optional[bytes]:
         """Reference NextBatchEx (indexed_recordio_split.cc:159-212):
@@ -1134,13 +1354,16 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             s = self._permutation[self._current]
             self._current += 1
             e = min(s + self.batch_size, self.index_end)
-            begin_off = self._index[s][0]
-            end_off = (
-                self._index[e][0]
-                if e < len(self._index)
-                else self.file_offset[-1]
-            )
-            chunk = self._read_at(begin_off, end_off - begin_off)
+            if self._compressed:
+                chunk = self._emit_range(s, e)
+            else:
+                begin_off = self._index[s][0]
+                end_off = (
+                    self._index[e][0]
+                    if e < len(self._index)
+                    else self.file_offset[-1]
+                )
+                chunk = self._read_at(begin_off, end_off - begin_off)
             if chunk:
                 self.records_consumed += e - s
                 self.records_emitted += e - s
@@ -1150,8 +1373,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             n = self._n_overflow or n_records
             parts: List[bytes] = []
             while len(parts) < n and self._current < len(self._permutation):
-                off, size = self._index[self._permutation[self._current]]
-                parts.append(self._read_at(off, size))
+                idx = self._permutation[self._current]
+                if self._compressed:
+                    parts.append(self._emit_range(idx, idx + 1))
+                else:
+                    off, size = self._index[idx]
+                    parts.append(self._read_at(off, size))
                 self._current += 1
             if not parts:
                 return None
@@ -1165,11 +1392,16 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._n_overflow = self._current + n - last
         if last <= self._current:
             return None
-        begin_off = self._index[self._current][0]
-        end_off = (
-            self._index[last][0] if last < len(self._index) else self.file_offset[-1]
-        )
-        chunk = self._read_at(begin_off, end_off - begin_off)
+        if self._compressed:
+            chunk = self._emit_range(self._current, last)
+        else:
+            begin_off = self._index[self._current][0]
+            end_off = (
+                self._index[last][0]
+                if last < len(self._index)
+                else self.file_offset[-1]
+            )
+            chunk = self._read_at(begin_off, end_off - begin_off)
         if chunk:
             self.records_consumed += last - self._current
             self.records_emitted += last - self._current

@@ -50,6 +50,7 @@ import numpy as np
 from ..data import native
 from ..io import split as io_split
 from ..io.filesystem import FileSystem
+from ..io.recordio import CFLAG_COMPRESSED, KMAGIC, decode_flag
 from ..io.uri import URISpec, rejoin_query, uri_int
 from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error, check
@@ -90,6 +91,35 @@ def _plain_local_path(uri: str) -> Optional[str]:
     if "://" in path:
         return None
     return path if os.path.isfile(path) else None
+
+
+_REC_SNIFF_BYTES = 4 << 20
+
+
+def _rec_file_compressed(path: str) -> bool:
+    """True when a .rec file carries compressed-block frames in its
+    leading window (one vectorized scan of up to 4 MB): compressed
+    shards must take the splitter path (decoded chunks), never the raw
+    mmap feed — the native kernel walks v1 frames only. Writers emit
+    uniform files, so the leading window decides routing; a compressed
+    section appearing later (hand-concatenated mixed shards) is caught
+    at parse time with an actionable error (_iter_mmap)."""
+    from ..io.recordio import chunk_has_compressed
+
+    with open(path, "rb") as f:
+        head = f.read(_REC_SNIFF_BYTES)
+    return chunk_has_compressed(head)
+
+
+def _stall_is_compressed_frame(chunk, off: int) -> bool:
+    """Does the undecodable tail start with a compressed-block head?"""
+    import struct
+
+    head = bytes(memoryview(chunk)[off : off + 8])
+    if len(head) != 8:
+        return False
+    magic, lrec = struct.unpack("<II", head)
+    return magic == KMAGIC and bool(decode_flag(lrec) & CFLAG_COMPRESSED)
 
 
 class _MmapChunks:
@@ -566,6 +596,12 @@ class FusedEllRowRecBatches(_EllSlotMixin):
             and "index" not in uspec.args
             else None
         )
+        if local is not None and _rec_file_compressed(local):
+            # compressed-block shard: the native kernel walks v1 frames
+            # only, so route through RecordIOSplitter — its chunks come
+            # back DECODED (parallel block decompress, io/recordio.py
+            # decode_chunk) and feed the same kernel unchanged
+            local = None
         self._mmap = local is not None
         # forward path + query (fragment stripped, matching the mmap fast
         # path): io_split.create resolves the sugar (shuffle_parts /
@@ -676,6 +712,18 @@ class FusedEllRowRecBatches(_EllSlotMixin):
                     self._slot = (self._slot + 1) % len(self._ring)
                     fill = 0
                 elif not progressed:
+                    if _stall_is_compressed_frame(chunk, off):
+                        # mixed v1+compressed file past the routing
+                        # sniff window (hand-concatenated shards): the
+                        # native kernel cannot walk compressed frames —
+                        # name the fix instead of a 'truncated' error
+                        raise Error(
+                            "rowrec: compressed RecordIO block mid-file; "
+                            "the mmap fast path reads v1 frames only — "
+                            "read via a sharded/indexed URI (splitter "
+                            "path decodes blocks) or normalize with "
+                            "`tools recompress`"
+                        )
                     stalled = True
                     break
             self._split.advance(off)

@@ -112,14 +112,19 @@ def _cmd_recordio(args) -> int:
             return 2
         with contextlib.ExitStack() as stack:
             dst = stack.enter_context(Stream.create(args.dst, "w"))
+            codec = _codec_arg(args)
             writer = (
                 IndexedRecordIOWriter(
-                    dst, stack.enter_context(Stream.create(args.index, "w"))
+                    dst,
+                    stack.enter_context(Stream.create(args.index, "w")),
+                    codec=codec,
+                    level=args.level,
                 )
                 if args.index
-                else RecordIOWriter(dst)
+                else RecordIOWriter(dst, codec=codec, level=args.level)
             )
             n = _pack_lines(args.src, writer)
+            writer.flush_block()
         print(f"packed {n} records", file=sys.stderr)
     else:
         with Stream.create(args.src, "r") as src:
@@ -150,11 +155,18 @@ def _pack_lines(src_uri: str, writer) -> int:
         sp.close()
 
 
+def _codec_arg(args):
+    """CLI codec option → writer codec argument (``none`` = v1)."""
+    codec = getattr(args, "codec", "none")
+    return None if codec in ("", "none") else codec
+
+
 def _cmd_rowrec(args) -> int:
     """Text dataset → rowrec .rec shards (+ optional count index) for
     the fused RecordIO→HBM staging path. ``--part/--num-parts`` convert
     one record-aligned shard so a large dataset converts in parallel
-    (e.g. one part per dmlc-submit worker)."""
+    (e.g. one part per dmlc-submit worker); ``--codec`` packs rows into
+    compressed blocks (docs/recordio.md)."""
     parser = create_parser(
         args.src, args.part, args.num_parts, type=args.format, threaded=False
     )
@@ -166,10 +178,64 @@ def _cmd_rowrec(args) -> int:
                 if args.index
                 else None
             )
-            n = write_rowrec(dst, iter(parser), index_stream=idx)
+            n = write_rowrec(
+                dst,
+                iter(parser),
+                index_stream=idx,
+                codec=_codec_arg(args),
+                level=args.level,
+            )
     finally:
         parser.close()
     print(f"wrote {n} rows to {args.dst}", file=sys.stderr)
+    return 0
+
+
+def _cmd_recompress(args) -> int:
+    """Convert a ``.rec`` (+``.idx``) between codecs in ONE stream pass:
+    read records through RecordIOReader (decodes v1 frames and any
+    compressed blocks alike), re-emit through a writer with the target
+    codec — ``--codec none`` decompresses back to the reference v1
+    format. The output round-trips byte-identically at the record
+    level; with ``--index`` a fresh sidecar is written in the format
+    matching the target codec (v1 byte offsets or block:in-offset
+    pairs)."""
+    from ..io.recordio import (
+        DEFAULT_BLOCK_BYTES,
+        IndexedRecordIOWriter,
+        RecordIOReader,
+        RecordIOWriter,
+    )
+
+    codec = _codec_arg(args)
+    block_bytes = args.block_bytes or DEFAULT_BLOCK_BYTES
+    n = 0
+    with contextlib.ExitStack() as stack:
+        src = stack.enter_context(Stream.create(args.src, "r"))
+        dst = stack.enter_context(Stream.create(args.dst, "w"))
+        writer = (
+            IndexedRecordIOWriter(
+                dst,
+                stack.enter_context(Stream.create(args.index, "w")),
+                codec=codec,
+                level=args.level,
+                block_bytes=block_bytes,
+            )
+            if args.index
+            else RecordIOWriter(
+                dst, codec=codec, level=args.level, block_bytes=block_bytes
+            )
+        )
+        for rec in RecordIOReader(src):
+            writer.write_record(rec)
+            n += 1
+        writer.flush_block()
+        out_bytes = writer.bytes_written
+    print(
+        f"recompressed {n} records -> {args.dst} "
+        f"(codec={codec or 'none'}, {out_bytes} bytes)",
+        file=sys.stderr,
+    )
     return 0
 
 
@@ -327,6 +393,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      help="echo records to stdout")
     spl.set_defaults(fn=_cmd_split)
 
+    def add_codec_opts(sp) -> None:
+        from ..io.codec import available_codecs
+
+        sp.add_argument(
+            "--codec", default="none",
+            choices=["none"] + available_codecs(),
+            help="compress records into blocks (none = v1 frames)",
+        )
+        sp.add_argument("--level", default=None, type=int,
+                        help="codec compression level (codec default)")
+
     rio = sub.add_parser("recordio", help="pack/unpack line records")
     rio.add_argument("action", choices=("pack", "unpack"))
     rio.add_argument("src")
@@ -334,6 +411,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
                      help="output URI (pack); unpack prints to stdout")
     rio.add_argument("--index", default="",
                      help="also write a count index (pack only)")
+    add_codec_opts(rio)
     rio.set_defaults(fn=_cmd_recordio)
 
     rr = sub.add_parser(
@@ -348,7 +426,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
     rr.add_argument("--part", default=0, type=int,
                     help="convert only this shard of src")
     rr.add_argument("--num-parts", default=1, type=int)
+    add_codec_opts(rr)
     rr.set_defaults(fn=_cmd_rowrec)
+
+    rcx = sub.add_parser(
+        "recompress",
+        help="convert a .rec between codecs in one stream pass",
+    )
+    rcx.add_argument("src", help="source .rec URI (v1 or compressed)")
+    rcx.add_argument("dst", help="output .rec URI")
+    rcx.add_argument("--index", default="",
+                     help="write a fresh index sidecar for dst")
+    rcx.add_argument(
+        "--block-bytes", default=None, type=int,
+        help="raw bytes buffered per compressed block",
+    )
+    add_codec_opts(rcx)
+    # recompress compresses unless told otherwise; --codec none converts
+    # a compressed file back to reference v1 frames
+    rcx.set_defaults(fn=_cmd_recompress, codec="zlib")
 
     dp = sub.add_parser(
         "dump", help="decode a rowrec .rec back to libsvm text"

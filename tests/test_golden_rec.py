@@ -127,3 +127,51 @@ def test_multipart_straddles_split_chunks(tmp_path):
             got.extend(bytes(r) for r in sp)
             sp.close()
         assert sorted(got) == sorted(records), f"num_parts={num_parts}"
+
+
+def test_multipart_chain_straddles_chunk_reader_subsplit():
+    """Regression (the classic reference edge case, previously
+    untested): a multi-part ESCAPED record (cflag 1/2/3 magic-collision
+    chain) whose continuation frames straddle a RecordIOChunkReader
+    sub-split (part_index/num_parts) boundary must be reassembled
+    EXACTLY ONCE — by the part owning its START head — and never
+    duplicated (a part whose range begins inside the chain must skip
+    forward past it) or dropped (the owning part must read through its
+    own range end to finish the chain)."""
+    rng = np.random.default_rng(7)
+    small = b"head-record"
+    # a big record with aligned magics planted every 32 bytes: the
+    # writer splits it into many cflag 1/2/3 parts spread over most of
+    # the chunk, so ANY mid-chunk boundary lands between chain frames
+    body = bytearray(rng.bytes(4096))
+    for off in range(64, 4000, 32):
+        body[off : off + 4] = MAGIC_BYTES
+    big = bytes(body)
+    tail = b"tail-record"
+    records = [small, big, tail]
+    ms = MemoryStream()
+    w = RecordIOWriter(ms)
+    frame_spans = []
+    for r in records:
+        start = w.bytes_written
+        w.write_record(r)
+        frame_spans.append((start, w.bytes_written))
+    assert w.except_counter > 50  # the chain really is many parts
+    chunk = ms.getvalue()
+    size = len(chunk)
+    for num_parts in (2, 3, 4, 6):
+        # at least one sub-split boundary must land strictly inside the
+        # big record's chain for the test to exercise the edge
+        nstep = ((size + num_parts - 1) // num_parts + 3) & ~3
+        bounds = [min(size, nstep * p) for p in range(1, num_parts)]
+        assert any(
+            frame_spans[1][0] < b < frame_spans[1][1] for b in bounds
+        ), f"num_parts={num_parts} boundary missed the chain"
+        per_part = [
+            [bytes(r) for r in RecordIOChunkReader(chunk, p, num_parts)]
+            for p in range(num_parts)
+        ]
+        got = [r for part in per_part for r in part]
+        assert got == records, f"num_parts={num_parts}: {len(got)} records"
+        # exactly-once, owned by the part whose range holds the START
+        assert sum(big in part for part in per_part) == 1

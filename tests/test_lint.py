@@ -185,6 +185,46 @@ def test_wall_clock_time_unscoped_outside_library(tmp_path):
     assert codes("import time\nt0 = time.time()\n", tmp_path) == []
 
 
+def test_codec_import_flagged(tmp_path):
+    """L009: compression modules are one codec site (io/codec.py),
+    mirroring the L006 (urlopen) and L008 (time.time) pattern."""
+    assert codes("import zlib\nzlib.crc32(b'x')\n", tmp_path) == ["L009"]
+    assert codes("import gzip\ngzip.compress(b'x')\n", tmp_path) == ["L009"]
+    assert codes("import zstandard\nzstandard.ZstdCompressor()\n",
+                 tmp_path) == ["L009"]
+    # submodule and from-imports do not dodge the rule
+    assert codes("import lz4.frame\nlz4.frame.compress(b'x')\n",
+                 tmp_path) == ["L009"]
+    assert codes("from zlib import crc32\ncrc32(b'x')\n",
+                 tmp_path) == ["L009"]
+    # ...nor does an alias
+    assert codes("import zlib as z\nz.decompress(b'x')\n",
+                 tmp_path) == ["L009"]
+
+
+def test_codec_import_quiet_outside_violations(tmp_path):
+    # unrelated modules whose names merely contain a codec name
+    assert codes("import zlib_tools\nzlib_tools.go()\n", tmp_path) == []
+    # the sanctioned route: everything compresses through the codec layer
+    src = (
+        "from dmlc_core_tpu.io.codec import get_codec\n"
+        "get_codec('zlib')\n"
+    )
+    assert codes(src, tmp_path) == []
+    # per-line opt-out works like every other rule
+    assert codes("import zlib  # noqa: L009 (test fixture)\nzlib.crc32\n",
+                 tmp_path) == []
+
+
+def test_codec_import_quiet_in_codec_layer(tmp_path):
+    """io/codec.py owns the compression imports and is exempt."""
+    d = tmp_path / "io"
+    d.mkdir()
+    f = d / "codec.py"
+    f.write_text("import zlib\nimport gzip\nzlib.crc32(gzip.compress(b''))\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -3,6 +3,7 @@ reference's Tier-2 standalone test programs: filesys_test.cc:8-40
 (ls/cat/cp), split_test.cc:8-24 (stream a shard), recordio_test.cc
 (pack/unpack), plus the rowrec conversion the staging path needs."""
 
+import os
 import subprocess
 import sys
 
@@ -222,6 +223,84 @@ def test_dump_fidelity_edge_cases(tmp_path, capsys):
     assert out.splitlines() == ["1 2:30:0.75 4:50:1"]
 
 
+def test_recordio_pack_codec_and_recompress_roundtrip(tmp_path, capsys):
+    """--codec packs compressed blocks; recompress converts v1 ↔
+    compressed in one stream pass and every direction round-trips;
+    the fresh --index sidecar drives indexed reads of the output."""
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.io.recordio import RecordIOReader
+    from dmlc_core_tpu.io.stream import FileStream
+
+    src = tmp_path / "lines.txt"
+    lines = [f"row-{i}-{'x' * (i % 17)}" for i in range(120)]
+    src.write_text("\n".join(lines) + "\n")
+    v1 = str(tmp_path / "v1.rec")
+    rc, _, err = run_cli(["recordio", "pack", str(src), v1], capsys)
+    assert rc == 0 and "packed 120" in err
+
+    comp = str(tmp_path / "comp.rec")
+    idx = comp + ".idx"
+    rc, _, err = run_cli(
+        ["recompress", v1, comp, "--codec", "zlib", "--index", idx], capsys
+    )
+    assert rc == 0 and "recompressed 120 records" in err
+    assert os.path.getsize(comp) < os.path.getsize(v1)
+    with FileStream(comp, "r") as f:
+        assert [r.decode() for r in RecordIOReader(f)] == lines
+    sp = io_split.create(f"{comp}?index={idx}&shuffle=window&window=32",
+                         0, 1, type="recordio", threaded=False)
+    assert sorted(bytes(r).decode() for r in sp) == sorted(lines)
+    sp.close()
+
+    # back to v1: byte-identical to the original pack output
+    back = str(tmp_path / "back.rec")
+    rc, _, err = run_cli(["recompress", comp, back, "--codec", "none"],
+                         capsys)
+    assert rc == 0
+    assert open(back, "rb").read() == open(v1, "rb").read()
+
+    # unpack reads compressed files transparently
+    rc, out, err = run_cli(["recordio", "unpack", comp], capsys)
+    assert rc == 0 and "unpacked 120" in err
+    assert out.splitlines() == lines
+
+    # direct compressed pack too
+    packed = str(tmp_path / "packed.rec")
+    rc, _, err = run_cli(
+        ["recordio", "pack", str(src), packed, "--codec", "gzip",
+         "--level", "1"],
+        capsys,
+    )
+    assert rc == 0 and "packed 120" in err
+    with FileStream(packed, "r") as f:
+        assert [r.decode() for r in RecordIOReader(f)] == lines
+
+
+def test_rowrec_codec_feeds_staging(libsvm_file, tmp_path, capsys):
+    """rowrec --codec: compressed shard + block index still feed both
+    the parser path and the fused ELL staging path unchanged."""
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.rec.idx")
+    rc, _, err = run_cli(
+        ["rowrec", libsvm_file, rec, "--format", "libsvm",
+         "--index", idx, "--codec", "zlib"],
+        capsys,
+    )
+    assert rc == 0 and "wrote 40 rows" in err
+    assert ":" in open(idx).read().split()[1]  # block:in-offset sidecar
+
+    it = create_row_block_iter(rec + "?format=rowrec")
+    labels = [x for b in it for x in np.asarray(b.label).tolist()]
+    assert sorted(labels) == sorted(float(i % 2) for i in range(40))
+
+    stream = ell_batches(
+        f"{rec}?index={idx}", BatchSpec(batch_size=8, layout="ell", max_nnz=3)
+    )
+    n = sum(int(b.n_valid) for b in stream)
+    stream.close()
+    assert n == 40
+
+
 def test_info_reports_features(capsys):
     """`tools info` emits the build_info report: kernel flags present and
     consistent with the loaded native module (base.h feature macros as
@@ -240,6 +319,12 @@ def test_info_reports_features(capsys):
         "libsvm_ell",
     }
     assert info["fused_kernels"]["libsvm_ell"] == native_mod.HAS_LIBSVM_ELL
+    # codec availability rides the same report (deploy targets can be
+    # checked remotely before shipping compressed shards)
+    from dmlc_core_tpu.io.codec import available_codecs
+
+    assert info["codecs"] == available_codecs()
+    assert {"raw", "zlib", "gzip"} <= set(info["codecs"])
 
 
 def test_bad_shard_args_are_cli_errors(libsvm_file, tmp_path, capsys):

@@ -30,6 +30,13 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  io/cloudfs.py, job timestamps in
                                  tracker/tracker.py — opt out per line
                                  with `# noqa: L008`.)
+  L009 direct compression import (zlib/gzip/zstandard/lz4 belong to the
+                                 codec layer: io/codec.py owns the one
+                                 compression site — registry, levels,
+                                 block header/crc, decode pool, decoded-
+                                 block cache — and is exempt; everything
+                                 else compresses through it so telemetry
+                                 and import guards can't be bypassed)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -252,9 +259,38 @@ def _check_wall_clock_time(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+_CODEC_MODULES = ("zlib", "gzip", "zstandard", "lz4")
+
+
+def _check_codec_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any import binding a compression module (zlib/gzip/zstandard/lz4,
+    incl. submodules like lz4.frame): compression is one layer
+    (io/codec.py — codec registry, block header + crc, decode pool,
+    decoded-block cache, telemetry), mirroring the L006 (urlopen) and
+    L008 (time.time) single-site pattern."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.partition(".")[0]
+                if root in _CODEC_MODULES:
+                    yield node.lineno, (
+                        f"direct import of {alias.name!r} (compression "
+                        f"belongs to the codec layer, io/codec.py)"
+                    )
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").partition(".")[0]
+            if node.level == 0 and root in _CODEC_MODULES:
+                yield node.lineno, (
+                    f"direct import from {node.module!r} (compression "
+                    f"belongs to the codec layer, io/codec.py)"
+                )
+
+
 # files allowed to call urlopen directly: the retry layer itself (the
 # leading '/' anchors the path segment — audio/retry.py is NOT exempt)
 _L006_EXEMPT = ("/io/retry.py",)
+# files allowed to import compression modules directly: the codec layer
+_L009_EXEMPT = ("/io/codec.py",)
 # trees allowed to call jax.device_put directly: the staging layer owns
 # the transfer call sites; tests build device-resident fixtures.
 # Anchored against the REPO-RELATIVE path (a checkout living under e.g.
@@ -276,6 +312,7 @@ CHECKS = [
     ("L006", _check_direct_urlopen),
     ("L007", _check_direct_device_put),
     ("L008", _check_wall_clock_time),
+    ("L009", _check_codec_imports),
 ]
 
 
@@ -299,6 +336,8 @@ def lint_file(path: Path) -> List[Finding]:
     rel_posix = rel.replace("\\", "/") if in_repo else None
     for code, fn in CHECKS:
         if code == "L006" and posix.endswith(_L006_EXEMPT):
+            continue
+        if code == "L009" and posix.endswith(_L009_EXEMPT):
             continue
         if code == "L007" and (
             rel_posix.startswith(_L007_EXEMPT_DIRS)
