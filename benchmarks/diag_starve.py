@@ -62,9 +62,15 @@ def put_loop(bufs, n, between=None):
 
 def shuffle_read_modes(fault: str = ""):
     """Raw split-layer drain per shuffle mode over the bench shard:
-    rows/s + io_stats, no parse/device in the loop. ``fault`` is a
-    fault:// spec (e.g. ``resets=2,errors=1,seed=7``): the drain then
-    exercises the retry layer healing seeded faults, visible as
+    rows/s + io_stats, no parse/device in the loop. Windowed modes
+    (record/batch/window) drain through ``next_gather_batch`` — the
+    zero-copy emission the fused staging layer consumes — so the
+    gather_batches/gather_bytes counters and the gather-vs-legacy
+    split-layer gap are visible here without any parse/device noise;
+    ``legacy_record`` keeps the reference's per-record seek storm for
+    contrast. ``fault`` is a fault:// spec (e.g.
+    ``resets=2,errors=1,seed=7``): the drain then exercises the retry
+    layer healing seeded faults, visible as
     retries/backoff_secs/faults_injected in the per-mode io_stats."""
     import bench
     from dmlc_core_tpu.io import split as io_split
@@ -73,11 +79,13 @@ def shuffle_read_modes(fault: str = ""):
     bench.ensure_rec_data()
     bench.ensure_rec_index()
     out = {}
-    for mode, extra in (
-        ("0", ""),
-        ("1", ""),
-        ("batch", "&batch_size=4096"),
+    for label, mode, extra in (
+        ("0", "0", ""),
+        ("record", "record", ""),
+        ("legacy_record", "record", "&legacy_shuffle=1"),
+        ("batch", "batch", "&batch_size=4096"),
         (
+            "window",
             "window",
             f"&window={bench.WINDOW}&merge_gap={bench.MERGE_GAP}",
         ),
@@ -87,20 +95,28 @@ def shuffle_read_modes(fault: str = ""):
             f"&shuffle={mode}{extra}"
         )
         s = io_split.create(uri, type="recordio", threaded=False)
+        gather = getattr(s, "supports_gather", lambda: False)()
         t0 = time.perf_counter()
         nbytes = 0
         while True:
-            chunk = s.next_batch(4096)
-            if chunk is None:
-                break
-            nbytes += len(chunk)
+            if gather:
+                g = s.next_gather_batch(4096)
+                if g is None:
+                    break
+                nbytes += int(g[2].sum())
+            else:
+                chunk = s.next_batch(4096)
+                if chunk is None:
+                    break
+                nbytes += len(chunk)
         dt = time.perf_counter() - t0
         stats = getattr(s, "io_stats", lambda: None)() or {}
         s.close()
-        out[f"shuffle_{mode}"] = {
+        out[f"shuffle_{label}"] = {
             "rows_per_sec": round(stats.get("records", 0) / dt, 1),
             "mb_per_sec": round(nbytes / dt / 1e6, 1),
             "secs": round(dt, 3),
+            "gather_drain": gather,
             **stats,
         }
     return out

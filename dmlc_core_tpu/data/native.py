@@ -27,6 +27,7 @@ __all__ = [
     "HAS_DENSE",
     "HAS_ELL",
     "HAS_CSV_DENSE",
+    "HAS_GATHER_ELL",
     "HAS_LIBFM_ELL",
     "HAS_LIBSVM_ELL",
     "parse_libsvm",
@@ -35,8 +36,10 @@ __all__ = [
     "parse_libsvm_dense",
     "parse_csv_dense",
     "parse_rowrec_ell",
+    "parse_rowrec_gather_ell",
     "parse_libfm_ell",
     "parse_libsvm_ell",
+    "shuffle_mt19937",
     "source_hash",
     "load",
 ]
@@ -45,8 +48,10 @@ AVAILABLE = False
 HAS_DENSE = False      # fused libsvm->dense-batch kernel present in the .so
 HAS_ELL = False        # fused recordio rowrec->ELL-batch kernel present
 HAS_CSV_DENSE = False  # fused csv->dense-batch kernel present
+HAS_GATHER_ELL = False  # shuffled-read (buf,starts,sizes)->ELL gather kernel
 HAS_LIBFM_ELL = False  # fused libfm->ELL-batch kernel present
 HAS_LIBSVM_ELL = False  # fused libsvm->ELL-batch kernel present
+HAS_SHUFFLE = False    # CPython-parity MT19937 Fisher-Yates kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -120,15 +125,16 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
     an in-session rebuild (the rebuilt file is a new inode, so dlopen
     returns a fresh handle; the old one is left to the process lifetime).
     """
-    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_LIBFM_ELL, \
-        HAS_LIBSVM_ELL, _LIB
+    global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_GATHER_ELL, \
+        HAS_LIBFM_ELL, HAS_LIBSVM_ELL, HAS_SHUFFLE, _LIB
     with _LOCK:
         if _LIB is not None and not force:
             return AVAILABLE
         if force:
             _LIB = None
             AVAILABLE = HAS_DENSE = HAS_ELL = HAS_CSV_DENSE = False
-            HAS_LIBFM_ELL = HAS_LIBSVM_ELL = False
+            HAS_GATHER_ELL = HAS_LIBFM_ELL = HAS_LIBSVM_ELL = False
+            HAS_SHUFFLE = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES
@@ -180,6 +186,23 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
                     ctypes.POINTER(_EllResult)]
                 lib.dmlc_parse_rowrec_ell.restype = None
                 HAS_ELL = True
+            # shuffled-read gather kernel: absent in older builds
+            if hasattr(lib, "dmlc_parse_rowrec_gather_ell"):
+                lib.dmlc_parse_rowrec_gather_ell.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_int64, ctypes.POINTER(_EllResult)]
+                lib.dmlc_parse_rowrec_gather_ell.restype = None
+                HAS_GATHER_ELL = True
+            # CPython-parity shuffle kernel: absent in older builds
+            if hasattr(lib, "dmlc_shuffle_mt19937"):
+                lib.dmlc_shuffle_mt19937.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int32, ctypes.c_int64,
+                    ctypes.c_void_p]
+                lib.dmlc_shuffle_mt19937.restype = None
+                HAS_SHUFFLE = True
             # fused libfm->ELL kernel: absent in older builds
             if hasattr(lib, "dmlc_parse_libfm_ell"):
                 lib.dmlc_parse_libfm_ell.argtypes = [
@@ -461,6 +484,102 @@ def _check_ell_buffers(indices, values, nnz, labels, weights):
     check(len(nnz) >= capacity and len(labels) >= capacity
           and len(weights) >= capacity, "1-D buffers shorter than capacity")
     return capacity, K
+
+
+def parse_rowrec_gather_ell(
+    buf: np.ndarray,
+    starts: np.ndarray,
+    sizes: np.ndarray,
+    lo: int,
+    n_recs: int,
+    indices: np.ndarray,
+    values: np.ndarray,
+    nnz: np.ndarray,
+    labels: np.ndarray,
+    weights: np.ndarray,
+    row_start: int,
+) -> Optional[Tuple[int, int, int, int, int]]:
+    """Shuffled-read gather: parse framed rowrec records at
+    ``(starts[lo + i], sizes[lo + i])`` byte slices of ``buf`` — the
+    ``next_gather_batch`` emission of a windowed shuffle
+    (io/split.py) — straight into rows ``row_start..`` of the
+    caller-owned ELL buffers (contract of ``parse_rowrec_ell``). One
+    call per batch, no per-record Python, no re-framing copy.
+
+    ``buf`` is uint8 1-D; ``starts``/``sizes`` are int64, consumed from
+    position ``lo`` (pointer offset — resumed calls never re-slice).
+    Stops at buffer-full. Returns (rows_written, recs_consumed,
+    truncated, bad_records, corrupt) — ``corrupt`` set when a slice
+    holds no complete record (the index and data disagree; callers fail
+    fast) — or None if the kernel is missing.
+    """
+    if not HAS_GATHER_ELL:
+        return None
+    from ..utils.logging import check
+
+    capacity, K = _check_ell_buffers(indices, values, nnz, labels, weights)
+    check(buf.flags.c_contiguous and buf.dtype == np.uint8,
+          "gather buf must be C-contiguous uint8")
+    check(starts.flags.c_contiguous and starts.dtype == np.int64
+          and sizes.flags.c_contiguous and sizes.dtype == np.int64,
+          "starts/sizes must be C-contiguous int64")
+    check(0 <= lo and lo + n_recs <= len(starts)
+          and len(sizes) >= len(starts),
+          "gather range outside starts/sizes")
+    res = _EllResult()
+    _LIB.dmlc_parse_rowrec_gather_ell(
+        ctypes.c_void_p(buf.ctypes.data),
+        ctypes.c_void_p(starts.ctypes.data + lo * 8),
+        ctypes.c_void_p(sizes.ctypes.data + lo * 8),
+        ctypes.c_int64(n_recs),
+        ctypes.c_int64(K),
+        ctypes.c_int32(1 if values.dtype == np.float16 else 0),
+        ctypes.c_void_p(indices.ctypes.data),
+        ctypes.c_void_p(values.ctypes.data),
+        ctypes.c_void_p(nnz.ctypes.data),
+        ctypes.c_void_p(labels.ctypes.data),
+        ctypes.c_void_p(weights.ctypes.data),
+        ctypes.c_int64(row_start),
+        ctypes.c_int64(capacity),
+        ctypes.byref(res),
+    )
+    return (res.rows_written, res.bytes_consumed, res.truncated,
+            res.bad_records, res.corrupt)
+
+
+def shuffle_mt19937(rnd, perm: np.ndarray) -> bool:
+    """Fisher-Yates shuffle ``perm`` (int64, C-contiguous) in place,
+    BIT-IDENTICAL to ``rnd.shuffle(perm)`` for a CPython
+    ``random.Random`` — same Mersenne-Twister draws, same rejection
+    sampling, same swaps — at native speed (the shuffled-read
+    permutation is pinned to random.Random order, docs/shuffle.md).
+
+    Returns False (caller falls back to ``rnd.shuffle``) when the
+    kernel is missing or ``len(perm) >= 2**31`` (getrandbits there
+    consumes multiple words per call, which the kernel does not
+    mirror). ``rnd`` is left untouched — callers derive a fresh
+    (seed, epoch) Random per epoch, so its post-shuffle state is never
+    observed.
+    """
+    if not HAS_SHUFFLE or len(perm) >= (1 << 31):
+        return False
+    from ..utils.logging import check
+
+    check(perm.flags.c_contiguous and perm.dtype == np.int64,
+          "shuffle perm must be C-contiguous int64")
+    state = rnd.getstate()
+    check(
+        state[0] == 3 and len(state[1]) == 625,
+        "unsupported random.Random state version",
+    )
+    key = np.asarray(state[1][:624], dtype=np.uint32)
+    _LIB.dmlc_shuffle_mt19937(
+        ctypes.c_void_p(key.ctypes.data),
+        ctypes.c_int32(state[1][624]),
+        ctypes.c_int64(len(perm)),
+        ctypes.c_void_p(perm.ctypes.data),
+    )
+    return True
 
 
 def parse_libfm_ell(

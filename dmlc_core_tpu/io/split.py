@@ -19,9 +19,12 @@ inner loops when present.
 from __future__ import annotations
 
 import bisect
+import mmap
 import os
 import random
 import re
+import threading
+from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
@@ -72,6 +75,17 @@ _SPANS = _REG.counter("io.split.spans", help="positioned reads issued")
 _SEEKS = _REG.counter("io.split.seeks", help="stream seek() calls")
 _BYTES_READ = _REG.counter("io.split.bytes_read", help="bytes read by splits")
 _RECORDS = _REG.counter("io.split.records", help="records emitted by splits")
+_GATHER_BATCHES = _REG.counter(
+    "io.split.gather_batches",
+    help="zero-copy (buf, starts, sizes) gather batches emitted",
+)
+_GATHER_BYTES = _REG.counter(
+    "io.split.gather_bytes", help="record bytes referenced by gather batches"
+)
+_GATHER_FALLBACK = _REG.counter(
+    "io.split.gather_fallback_batches",
+    help="shuffled emissions that re-framed bytes instead of gathering",
+)
 
 
 class InputSplit:
@@ -580,17 +594,171 @@ def plan_coalesced_spans(
     return out
 
 
+def _native_shuffle(rnd: random.Random, perm: np.ndarray) -> bool:
+    """Shuffle ``perm`` in place bit-identically to ``rnd.shuffle``
+    via the native MT19937 kernel; False = caller must fall back to
+    ``rnd.shuffle`` (kernel missing, or the permutation is too large
+    for the single-word getrandbits rule)."""
+    try:
+        from ..data import native as _native
+    except ImportError:  # data layer unavailable (minimal installs)
+        return False
+    return _native.shuffle_mt19937(rnd, perm)
+
+
+def _index_stat_key(index_uri: str, total: int):
+    """Cache key for a LOCAL index file — (uri, mtime_ns, size, total)
+    — or None (remote/unstattable: no caching, a stat per construction
+    there would be the network round trip the cache exists to avoid and
+    a stale remote index served forever is worse than a re-read)."""
+    path = (
+        index_uri[len("file://"):]
+        if index_uri.startswith("file://")
+        else index_uri
+    )
+    if "://" in path:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (index_uri, st.st_mtime_ns, st.st_size, total)
+
+
+def _load_index_uri(index_uri: str, total: int) -> Dict[str, np.ndarray]:
+    stream = Stream.create(index_uri, "r")
+    with stream:
+        text = stream.read().decode()
+    return _parse_index_text(text, total, index_uri)
+
+
+# parsed-index LRU: keyed by (uri, mtime_ns, size, total), bounded by
+# TOTAL ARRAY BYTES (DMLC_INDEX_CACHE_MB, default 256) — an lru_cache
+# by entry count would pin multi-GB parses for the process lifetime
+# long after every splitter referencing them closed
+_INDEX_CACHE: "OrderedDict[Tuple, Dict[str, np.ndarray]]" = OrderedDict()
+_INDEX_CACHE_BYTES = 0
+_INDEX_CACHE_LOCK = threading.Lock()
+
+
+def _index_cache_budget() -> int:
+    return max(0, int(os.environ.get("DMLC_INDEX_CACHE_MB", "256"))) << 20
+
+
+def _load_index_cached(stat_key) -> Dict[str, np.ndarray]:
+    """Parsed index arrays keyed by (uri, mtime_ns, size, total): a
+    sharded/threaded fan-out constructs one splitter per sub-shard and
+    must not re-read and re-parse the same (possibly large) index file
+    per thread — but a rewritten local file re-parses (mtime key). The
+    arrays are shared read-only across splitters; parses bigger than
+    the whole budget are served uncached."""
+    global _INDEX_CACHE_BYTES
+    with _INDEX_CACHE_LOCK:
+        data = _INDEX_CACHE.get(stat_key)
+        if data is not None:
+            _INDEX_CACHE.move_to_end(stat_key)
+            return data
+    data = _load_index_uri(stat_key[0], stat_key[3])
+    nbytes = sum(v.nbytes for v in data.values())
+    budget = _index_cache_budget()
+    if nbytes <= budget:
+        with _INDEX_CACHE_LOCK:
+            if stat_key not in _INDEX_CACHE:
+                _INDEX_CACHE[stat_key] = data
+                _INDEX_CACHE_BYTES += nbytes
+            _INDEX_CACHE.move_to_end(stat_key)
+            while _INDEX_CACHE_BYTES > budget and len(_INDEX_CACHE) > 1:
+                _k, old = _INDEX_CACHE.popitem(last=False)
+                _INDEX_CACHE_BYTES -= sum(v.nbytes for v in old.values())
+    return data
+
+
+def _parse_index_text(
+    text: str, total: int, index_uri: str
+) -> Dict[str, np.ndarray]:
+    """Vectorized index parse → read-only numpy arrays. v1 sidecar
+    (``key offset``): {'offs', 'sizes'}; compressed-block sidecar
+    (``key block:inoff``, docs/recordio.md): the record→block geometry.
+    One C-speed str→int64 conversion instead of a 2-per-record Python
+    loop — the index parse sits on every indexed construction's
+    critical path (it gated the shuffled-epoch rebuild)."""
+    vals = text.split()[1::2]
+    if not vals:
+        raise Error(f"empty index file {index_uri!r}")
+    mixed = Error(
+        f"index file {index_uri!r} mixes v1 and compressed-block offsets"
+    )
+    if ":" in vals[0]:
+        out = _parse_compressed_index(vals, total, index_uri, mixed)
+    else:
+        try:
+            offs = np.sort(np.asarray(vals, dtype=np.int64))
+        except ValueError:
+            raise mixed from None
+        sizes = np.concatenate(
+            (np.diff(offs), [total - int(offs[-1])])
+        ).astype(np.int64)
+        out = {"offs": offs, "sizes": sizes}
+    for v in out.values():
+        v.setflags(write=False)  # cached arrays are shared across splits
+    return out
+
+
+def _parse_compressed_index(
+    vals: List[str], total: int, index_uri: str, mixed: Error
+) -> Dict[str, np.ndarray]:
+    """Compressed sidecar: ``key  <block>:<in>`` per record — the block
+    frame's file offset and the record's frame start inside the DECODED
+    block. Records sort by (block, in-offset), i.e. file order,
+    matching the v1 offset sort."""
+    try:
+        pairs = sorted(
+            (int(a), int(b)) for a, _, b in (t.partition(":") for t in vals)
+        )
+    except ValueError:
+        raise mixed from None
+    rec_boff = np.asarray([p[0] for p in pairs], dtype=np.int64)
+    rec_inoff = np.asarray([p[1] for p in pairs], dtype=np.int64)
+    boffs, inv = np.unique(rec_boff, return_inverse=True)
+    rec_block = inv.astype(np.int64)
+    block_sizes = np.concatenate(
+        (np.diff(boffs), [total - int(boffs[-1])])
+    ).astype(np.int64)
+    check(
+        bool((block_sizes > 0).all()) and int(boffs[0]) >= 0,
+        f"index file {index_uri!r}: block offsets outside the "
+        f"{total}-byte dataset",
+    )
+    # next record's in-block offset within the same block; -1 = the
+    # block's last record (slice runs to the decoded end)
+    nxt = np.full(len(pairs), -1, dtype=np.int64)
+    same = rec_block[1:] == rec_block[:-1]
+    nxt[:-1][same] = rec_inoff[1:][same]
+    return {
+        "rec_block": rec_block,
+        "rec_inoff": rec_inoff,
+        "rec_next": nxt,
+        "block_offs": boffs,
+        "block_sizes": block_sizes,
+    }
+
+
 class _SpanReader:
     """Positioned span reads over a split's file table, by absolute
     dataset offset (spans may cross file boundaries — the index is
     global).
 
-    Local files are served via ``os.pread`` on cached descriptors: no
-    seek syscall, no shared stream cursor, so the window-shuffle
-    readahead thread can read while the consumer thread drains —
-    without racing ``InputSplitBase._fs``. Remote backends fall back to
-    one private SeekStream per file (seek+read pairs, counted in
-    ``seeks``)."""
+    Local files are served as ZERO-COPY ``mmap`` views: a span "read"
+    is a memoryview of the page cache — no buffer allocation, no
+    memcpy, no seek syscall, and no shared stream cursor, so the
+    window-shuffle readahead thread can plan while the consumer thread
+    drains without racing ``InputSplitBase._fs`` — and the gather
+    kernel parses shuffled records straight out of the mapping. Views
+    stay valid until ``close()`` (which defers unmapping while any
+    view is still exported — the ``BufferError`` guard below). Files
+    that cannot map (empty, special) fall back to ``os.pread`` on a
+    cached descriptor; remote backends fall back to one private
+    SeekStream per file (seek+read pairs, counted in ``seeks``)."""
 
     def __init__(
         self,
@@ -602,6 +770,7 @@ class _SpanReader:
         self._file_offset = file_offset
         self._filesys = filesys
         self._fds: Dict[int, int] = {}
+        self._mmaps: Dict[int, mmap.mmap] = {}
         self._streams: Dict[int, SeekStream] = {}
         self.seeks = 0
 
@@ -611,13 +780,24 @@ class _SpanReader:
             return path[len("file://"):]
         return None if "://" in path else path
 
-    def _read_in_file(self, fp: int, rel_off: int, size: int) -> bytes:
+    def _read_in_file(self, fp: int, rel_off: int, size: int):
+        mm = self._mmaps.get(fp)
+        if mm is not None:
+            return memoryview(mm)[rel_off : rel_off + size]
         fd = self._fds.get(fp)
         if fd is None and fp not in self._streams:
             local = self._local_path(fp)
             if local is not None:
                 fd = os.open(local, os.O_RDONLY)
-                self._fds[fp] = fd
+                try:
+                    mm = mmap.mmap(fd, 0, access=mmap.ACCESS_READ)
+                except (OSError, ValueError):
+                    self._fds[fp] = fd  # unmappable: pread fallback
+                else:
+                    os.close(fd)
+                    fd = None
+                    self._mmaps[fp] = mm
+                    return memoryview(mm)[rel_off : rel_off + size]
             else:
                 s = self._filesys.open(self._files[fp].path, "r")
                 check(
@@ -646,7 +826,10 @@ class _SpanReader:
                 size -= len(data)
         return out[0] if len(out) == 1 else b"".join(out)
 
-    def read(self, offset: int, size: int) -> bytes:
+    def read(self, offset: int, size: int):
+        """Span bytes at absolute dataset ``offset`` — a zero-copy
+        memoryview when one mmapped file covers the span, else joined
+        bytes."""
         out: List[bytes] = []
         while size > 0:
             fp = bisect.bisect_right(self._file_offset, offset) - 1
@@ -675,6 +858,12 @@ class _SpanReader:
             except OSError:
                 pass
         self._fds.clear()
+        for mm in self._mmaps.values():
+            try:
+                mm.close()
+            except BufferError:
+                pass  # a handed-out span view is still alive; GC finishes
+        self._mmaps.clear()
         for s in self._streams.values():
             s.close()
         self._streams.clear()
@@ -688,20 +877,32 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
     Index file: whitespace-separated ``index offset`` pairs
     (ReadIndexFile, indexed_recordio_split.cc:43-62).
 
-    ``shuffle`` modes:
+    ``shuffle`` modes — all three ride ONE emission path (the window
+    machinery below: coalesced-span loads into a client-side buffer,
+    vectorized/index-driven emission, optional zero-copy
+    ``next_gather_batch``); they differ only in the permutation they
+    emit and how it is cut into windows:
 
-    - ``True`` / ``'record'``: full per-record permutation — one seek
-      per record, exactly the reference's NextBatchEx shuffle
-      (indexed_recordio_split.cc:159-191). Statistically perfect,
-      seek-bound on every real filesystem.
+    - ``True`` / ``'record'``: full per-record permutation — the
+      reference's NextBatchEx shuffle order
+      (indexed_recordio_split.cc:159-191) served as ONE window covering
+      the whole shard on local uncompressed files: every byte is read
+      once through coalesced spans (a zero-copy mmap of the page
+      cache) and records leave the buffer in permutation order.
+      Compressed or remote sources bound the window to ``window``
+      records instead (same order — windows only cut the global
+      permutation — but a shard-wide buffer there would materialize
+      the whole shard in RAM).
+      ``legacy_shuffle=True`` (URI: ``&legacy_shuffle=1``) forces the
+      reference's literal one-seek-per-record loop instead — same
+      order, kept for A/B measurement of the gather fast path.
     - ``'batch'``: permute SPANS of ``batch_size`` contiguous records
-      and read each span with one coalesced seek (records inside a span
-      keep file order). The chunk-shuffle trade every production reader
-      makes (the reference's own ImageRecordIter-style consumers
-      re-shuffle in a client-side buffer); sequential-read throughput at
-      shuffle granularity ``batch_size``.
+      (records inside a span keep file order) — the chunk-shuffle trade
+      every production reader makes; the span-expanded per-record
+      permutation is served through the same windowed loader, so each
+      window's spans coalesce and prefetch like window mode.
     - ``'window'``: full per-record permutation (identical epoch order
-      to ``'record'`` for the same seed) with COALESCED I/O — the
+      to ``'record'`` for the same seed) with bounded memory — the
       permutation is cut into windows of ``window`` records, each
       window's index entries are sorted by byte offset and merged into
       large spans (``plan_coalesced_spans``, gap threshold
@@ -711,9 +912,14 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
       in permutation order. A ThreadedIter readahead stage loads window
       k+1's spans while the consumer drains window k. Memory is bounded
       by ~2-3 windows of records; read amplification is bounded by the
-      merged gap bytes. This is input_split_shuffle.h's macro-shuffle
-      trick taken to its limit: record-perfect randomness at
-      near-sequential read cost.
+      merged gap bytes.
+
+    Emission from the buffer is batched and index-driven, never
+    per-record Python: ``next_batch_ex`` re-frames whole batches with
+    one fancy-index gather (the NumPy fallback path), and
+    ``next_gather_batch`` hands ``(buf, starts, sizes)`` views straight
+    to a native gather kernel (staging/fused.py) with zero copies —
+    docs/shuffle.md.
     """
 
     KRAND_MAGIC = 111  # reference indexed_recordio_split.h:82
@@ -732,6 +938,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         window: int = 65536,
         merge_gap: int = 65536,
         readahead: bool = True,
+        legacy_shuffle: bool = False,
         filesys: Optional[FileSystem] = None,
     ) -> None:
         """``epoch``/``skip_records``: data-position fast-forward (§5.4
@@ -755,6 +962,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         mode = normalize_shuffle(shuffle)
         self.shuffle_mode: Optional[str] = mode if mode else None
         self.shuffle = self.shuffle_mode is not None
+        # legacy escape hatch: the reference's literal per-record seek
+        # loop for shuffle='record' (A/B baseline for the gather path)
+        self._legacy_record = bool(legacy_shuffle) and mode == "record"
         self.batch_size = batch_size
         check(window >= 1, f"window={window} must be >= 1")
         check(merge_gap >= 0, f"merge_gap={merge_gap} must be >= 0")
@@ -771,17 +981,26 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._win_buf: Optional[_WinBuf] = None
         self._win_pos = 0
         self._win_start = 0
+        self._win_skip = 0
+        self._all_local: Optional[bool] = None  # resolved lazily from files
         self._span_reader: Optional[_SpanReader] = None
         # I/O-shape counters (cumulative across epochs; io_stats())
         self.spans_read = 0
         self.seek_calls = 0
         self.bytes_read = 0
         self.records_emitted = 0
+        self.gather_batches = 0
+        self.gather_bytes = 0
+        self.gather_fallback_batches = 0
         self._seed = seed
         self.epoch = epoch - 1  # before_first() increments into `epoch`
         self._skip_next = skip_records
         self.records_consumed = 0
-        self._index: List[Tuple[int, int]] = []  # (offset, size)
+        self._index_loaded = False
+        # numpy index mirror: per-record file offsets and framed sizes
+        # (vectorized span planning + arithmetic range reads; no
+        # per-record tuple list — the parse is one C-speed conversion,
+        # shared across sub-shard splitters via _load_index_cached)
         self._index_offs = np.empty(0, dtype=np.int64)
         self._index_sizes = np.empty(0, dtype=np.int64)
         # compressed-block geometry (set by _read_index_file when the
@@ -804,61 +1023,24 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         super().__init__(uri, part_index, num_parts, filesys=filesys)
 
     def _read_index_file(self) -> None:
-        stream = Stream.create(self._index_uri, "r")
-        with stream:
-            text = stream.read().decode()
-        vals = text.split()[1::2]
-        if not vals:
-            raise Error(f"empty index file {self._index_uri!r}")
         total = self.file_offset[-1]
-        if any(":" in t for t in vals):
-            check(
-                all(":" in t for t in vals),
-                f"index file {self._index_uri!r} mixes v1 and "
-                f"compressed-block offsets",
-            )
-            self._read_compressed_index(vals, total)
+        skey = _index_stat_key(self._index_uri, total)
+        data = (
+            _load_index_cached(skey)
+            if skey is not None
+            else _load_index_uri(self._index_uri, total)
+        )
+        self._index_loaded = True
+        if "offs" in data:
+            self._index_offs = data["offs"]
+            self._index_sizes = data["sizes"]
             return
-        offsets = sorted(int(tok) for tok in vals)
-        self._index = [
-            (offsets[i], (offsets[i + 1] if i + 1 < len(offsets) else total) - offsets[i])
-            for i in range(len(offsets))
-        ]
-        # numpy mirror of the index for the window-shuffle span planner
-        # (vectorized gather + argsort over whole windows)
-        self._index_offs = np.asarray(offsets, dtype=np.int64)
-        self._index_sizes = np.concatenate(
-            (np.diff(self._index_offs), [total - offsets[-1]])
-        ).astype(np.int64)
-
-    def _read_compressed_index(self, vals: List[str], total: int) -> None:
-        """Compressed sidecar: ``key  <block>:<in>`` per record — the
-        block frame's file offset and the record's frame start inside
-        the DECODED block. Records sort by (block, in-offset), i.e.
-        file order, matching the v1 offset sort."""
-        pairs = sorted(
-            (int(a), int(b)) for a, _, b in (t.partition(":") for t in vals)
-        )
         self._compressed = True
-        rec_boff = np.asarray([p[0] for p in pairs], dtype=np.int64)
-        self._rec_inoff = np.asarray([p[1] for p in pairs], dtype=np.int64)
-        boffs, inv = np.unique(rec_boff, return_inverse=True)
-        self._block_offs = boffs
-        self._rec_block = inv.astype(np.int64)
-        self._block_sizes = np.concatenate(
-            (np.diff(boffs), [total - int(boffs[-1])])
-        ).astype(np.int64)
-        check(
-            bool((self._block_sizes > 0).all()) and int(boffs[0]) >= 0,
-            f"index file {self._index_uri!r}: block offsets outside the "
-            f"{total}-byte dataset",
-        )
-        # next record's in-block offset within the same block; -1 = the
-        # block's last record (slice runs to the decoded end)
-        nxt = np.full(len(pairs), -1, dtype=np.int64)
-        same = self._rec_block[1:] == self._rec_block[:-1]
-        nxt[:-1][same] = self._rec_inoff[1:][same]
-        self._rec_next = nxt
+        self._rec_block = data["rec_block"]
+        self._rec_inoff = data["rec_inoff"]
+        self._rec_next = data["rec_next"]
+        self._block_offs = data["block_offs"]
+        self._block_sizes = data["block_sizes"]
         # decoded-block cache identity: per-file (path, size, local
         # mtime_ns) + total size + block layout + (per lookup) the
         # block's file offset. The mtime term makes an IN-PLACE rewrite
@@ -880,20 +1062,20 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 except OSError:
                     pass
             sig.append((path, int(f.size), mtime))
-        self._cache_key = (tuple(sig), int(total), hash(boffs.tobytes()))
+        self._cache_key = (
+            tuple(sig), int(total), hash(self._block_offs.tobytes())
+        )
         # byte-offset anchors: a record 'sits at' its block's file
         # offset, which keeps reset_partition's offset_begin/offset_end
         # bookkeeping meaningful (sizes are a compressed-path no-op)
-        anchor = boffs[self._rec_block]
-        self._index = [(int(a), 0) for a in anchor.tolist()]
-        self._index_offs = anchor
-        self._index_sizes = np.zeros(len(pairs), dtype=np.int64)
+        self._index_offs = self._block_offs[self._rec_block]
+        self._index_sizes = np.zeros(len(self._rec_block), dtype=np.int64)
 
     def reset_partition(self, part_index: int, num_parts: int) -> None:
         """Record-count range (reference indexed_recordio_split.cc:12-41)."""
-        if not self._index:
+        if not self._index_loaded:
             self._read_index_file()
-        ntotal = len(self._index)
+        ntotal = len(self._index_offs)
         nstep = (ntotal + num_parts - 1) // num_parts
         if part_index * nstep >= ntotal:
             self.offset_begin = self.offset_end = self.offset_curr = 0
@@ -907,10 +1089,10 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._close_fs()
             return
         self.index_begin = part_index * nstep
-        self.offset_begin = self._index[self.index_begin][0]
+        self.offset_begin = int(self._index_offs[self.index_begin])
         self.index_end = min((part_index + 1) * nstep, ntotal)
         if self.index_end < ntotal:
-            self.offset_end = self._index[self.index_end][0]
+            self.offset_end = int(self._index_offs[self.index_end])
         else:
             self.offset_end = self.file_offset[-1]
         self._n_overflow = 0
@@ -929,35 +1111,61 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self.KRAND_MAGIC + self._seed + 1_000_003 * self.epoch
         )
         if self.shuffle_mode == "batch":
-            # permute span STARTS; each span is batch_size contiguous
-            # records read in one seek. Only FULL spans are shuffled —
-            # the remainder span (ntotal % batch_size records) always
-            # reads last, so every multiple of batch_size is a span
-            # boundary and therefore a resumable position (skip_records
-            # would otherwise land inside the short span whenever the
-            # shuffle placed it early)
-            total = self.index_end - self.index_begin
-            full_end = self.index_begin + (total // self.batch_size) * (
-                self.batch_size
-            )
-            self._permutation = list(
-                range(self.index_begin, full_end, self.batch_size)
-            )
-            rnd.shuffle(self._permutation)
-            if full_end < self.index_end:
-                self._permutation.append(full_end)
-            self._current = 0
-        elif self.shuffle_mode in ("record", "window"):
             # tear the previous epoch's readahead down FIRST: a live
             # producer slicing a half-built permutation would issue (and
             # count) span reads for a window that is about to be thrown
             # away
             self._teardown_window_pipeline()
-            # window mode emits the SAME (seed, epoch) permutation as
-            # record mode — the window machinery only changes how the
-            # bytes reach the buffer, never the order they leave it
-            self._permutation = list(range(self.index_begin, self.index_end))
-            rnd.shuffle(self._permutation)
+            # permute span STARTS; each span is batch_size contiguous
+            # records served in file order. Only FULL spans are
+            # shuffled — the remainder span (ntotal % batch_size
+            # records) always reads last, so every multiple of
+            # batch_size is a span boundary and therefore a resumable
+            # position (skip_records would otherwise land inside the
+            # short span whenever the shuffle placed it early). The
+            # span permutation is then expanded to a per-record
+            # permutation so batch mode rides the same windowed
+            # gather emission as record/window.
+            total = self.index_end - self.index_begin
+            full_end = self.index_begin + (total // self.batch_size) * (
+                self.batch_size
+            )
+            span_starts = list(
+                range(self.index_begin, full_end, self.batch_size)
+            )
+            rnd.shuffle(span_starts)
+            if full_end < self.index_end:
+                span_starts.append(full_end)
+            starts = np.asarray(span_starts, dtype=np.int64)
+            counts = np.minimum(starts + self.batch_size, self.index_end) - (
+                starts
+            )
+            pos = np.arange(int(counts.sum()), dtype=np.int64)
+            self._permutation = np.repeat(starts, counts) + (
+                pos - np.repeat(np.cumsum(counts) - counts, counts)
+            )
+            self._current = 0
+        elif self.shuffle_mode in ("record", "window"):
+            self._teardown_window_pipeline()
+            if self._legacy_record:
+                self._permutation = list(
+                    range(self.index_begin, self.index_end)
+                )
+                rnd.shuffle(self._permutation)
+            else:
+                # window mode emits the SAME (seed, epoch) permutation
+                # as record mode — the window machinery only changes
+                # how the bytes reach the buffer, never the order they
+                # leave it. The native MT19937 kernel replays
+                # random.Random's exact draw/swap sequence (parity
+                # tested), so the permutation stays bit-identical to
+                # the legacy loop's whichever path computes it.
+                perm = np.arange(
+                    self.index_begin, self.index_end, dtype=np.int64
+                )
+                if not _native_shuffle(rnd, perm):
+                    rnd.shuffle(perm)  # same swaps, interpreter speed
+                self._permutation = perm
             self._current = 0
         else:
             self._current = self.index_begin
@@ -975,32 +1183,32 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             0 <= n <= total,
             f"skip_records={n} outside this shard's {total} records",
         )
-        if self.shuffle_mode == "batch":
-            # walk permuted spans, accumulating their true lengths (the
-            # span containing index_end is short)
-            done = 0
-            while done < n and self._current < len(self._permutation):
-                s = self._permutation[self._current]
-                span = min(s + self.batch_size, self.index_end) - s
+        if self.windowed:
+            if self.shuffle_mode == "batch":
+                # only FULL spans shuffle (the remainder span reads
+                # last), so resumable positions are exactly the
+                # batch_size multiples inside the full-span range, plus
+                # end-of-shard
+                full = (total // self.batch_size) * self.batch_size
                 check(
-                    done + span <= n,
-                    f"skip_records={n} lands inside a shuffled span of "
-                    f"{span} (checkpoint at span boundaries — batch_size="
+                    (n % self.batch_size == 0 and n <= full) or n == total,
+                    f"skip_records={n} lands inside a shuffled span "
+                    f"(checkpoint at span boundaries — batch_size="
                     f"{self.batch_size} multiples)",
                 )
-                done += span
-                self._current += 1
-        elif self.shuffle_mode == "window":
-            check(
-                n % self.window == 0 or n == total,
-                f"skip_records={n} lands inside a shuffled window of "
-                f"{self.window} (checkpoint at window boundaries — "
-                f"window={self.window} multiples)",
-            )
-            self._win_start = (
-                self._n_windows() if n == total else n // self.window
-            )
-        elif self.shuffle_mode == "record":
+            elif self.shuffle_mode == "window":
+                check(
+                    n % self.window == 0 or n == total,
+                    f"skip_records={n} lands inside a shuffled window of "
+                    f"{self.window} (checkpoint at window boundaries — "
+                    f"window={self.window} multiples)",
+                )
+            # record mode: any position resumes (the first window is
+            # simply sliced from n on, so skipped records are never read)
+            W = self._eff_window()
+            self._win_start = n // W
+            self._win_skip = n - self._win_start * W
+        elif self._legacy_record:
             self._current = n
         else:
             self._current = self.index_begin + n
@@ -1155,8 +1363,49 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         )
 
     # -- window-shuffle machinery -------------------------------------------
+    @property
+    def windowed(self) -> bool:
+        """True when this split serves its shuffle through the unified
+        window/gather machinery (record without the legacy escape
+        hatch, batch, window) — i.e. ``next_gather_batch`` is live and
+        the split prefetches internally (create() returns it bare)."""
+        return (
+            self.shuffle_mode in ("record", "batch", "window")
+            and not self._legacy_record
+        )
+
+    def supports_gather(self) -> bool:
+        """Whether ``next_gather_batch`` serves this configuration."""
+        return self.windowed
+
+    def _eff_window(self) -> int:
+        """Records per shuffle window on the unified path: record mode
+        is one window covering the shard — but ONLY where that window
+        is a zero-copy mmap of local uncompressed files (resident =
+        page cache, each byte read once). On compressed or remote
+        sources a shard-wide window would MATERIALIZE the whole shard
+        (decoded blocks / downloaded spans) in one buffer, so record
+        mode bounds itself to ``self.window``-record windows there —
+        the emitted order is IDENTICAL for any window size (the
+        permutation is global; windows only cut it), memory stays
+        bounded, and the cost is window-count read passes like window
+        mode. batch/window modes always use ``self.window``."""
+        if self.shuffle_mode == "record":
+            if not self._compressed and self._files_all_local():
+                return max(1, len(self._permutation))
+            return max(1, self.window)
+        return self.window
+
+    def _files_all_local(self) -> bool:
+        if self._all_local is None:
+            self._all_local = all(
+                f.path.startswith("file://") or "://" not in f.path
+                for f in self.files
+            )
+        return self._all_local
+
     def _n_windows(self) -> int:
-        return -(-len(self._permutation) // self.window)
+        return -(-len(self._permutation) // self._eff_window())
 
     def _teardown_window_pipeline(self) -> None:
         if self._win_iter is not None:
@@ -1166,24 +1415,23 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._win_buf = None
         self._win_pos = 0
         self._win_start = 0
+        self._win_skip = 0
 
     def _load_window(
-        self, k: int
+        self, lo: int, hi: int
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-        """Read window k's records via coalesced spans. Returns the
-        client-side shuffle buffer ``(buf, rel, size)``: one uint8
-        buffer of span bytes plus each record's start offset and length
-        in PERMUTATION order — the emission path gathers records out
-        with vectorized fancy indexing, no per-record Python.
+        """Read the records at permutation positions [lo, hi) via
+        coalesced spans. Returns the client-side shuffle buffer
+        ``(buf, rel, size)``: one uint8 buffer of span bytes plus each
+        record's start offset and length in PERMUTATION order — the
+        emission path gathers records out with vectorized fancy
+        indexing, no per-record Python.
 
         When the merged gaps more than double the buffer (aggressive
         ``merge_gap`` over a sparse window), the buffer is compacted to
         the records' own bytes with one extra gather, bounding resident
         memory at ~the window's record bytes."""
-        W = self.window
-        perm = np.asarray(
-            self._permutation[k * W : (k + 1) * W], dtype=np.int64
-        )
+        perm = np.asarray(self._permutation[lo:hi], dtype=np.int64)
         if self._compressed:
             return self._load_window_compressed(perm)
         offs = self._index_offs[perm]
@@ -1248,8 +1496,19 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
     def _window_stream(
         self,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        # _win_start/_win_skip were fixed by before_first/_fast_forward
+        # before the first pull starts this generator; the skip applies
+        # to the first window only (record-mode resume at any position)
+        W = self._eff_window()
+        n = len(self._permutation)
+        skip = self._win_skip
         for k in range(self._win_start, self._n_windows()):
-            yield self._load_window(k)
+            lo = min(k * W + skip, n)
+            hi = min((k + 1) * W, n)
+            skip = 0
+            if lo >= hi:
+                continue
+            yield self._load_window(lo, hi)
 
     def _refill_window(self) -> bool:
         """Pull the next loaded window into the emission buffer; False
@@ -1306,6 +1565,61 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             got += take
         return got, chunks
 
+    def next_gather_batch(
+        self, n_records: int
+    ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Zero-copy batched emission for the unified shuffle path:
+        returns ``(buf, starts, sizes)`` — a uint8 view of the current
+        window's span bytes plus int64 byte offsets/lengths of up to
+        ``n_records`` framed records IN PERMUTATION ORDER — or None at
+        end of epoch. No record bytes are copied or re-framed; the
+        caller parses straight out of the window buffer (the native
+        gather kernel, staging/fused.py) and must finish with the views
+        before pulling past the current window (the buffer is recycled
+        when the window drains). A call never crosses a window
+        boundary, so short returns are normal — keep calling until the
+        batch is full or None arrives. Only valid when ``windowed``
+        (``supports_gather()``)."""
+        check(self.windowed, "next_gather_batch needs a windowed shuffle")
+        buf_state = self._win_buf
+        if buf_state is None or self._win_pos >= len(buf_state[1]):
+            if not self._refill_window():
+                return None
+            buf_state = self._win_buf
+        buf, rel, size = buf_state  # type: ignore[misc]
+        take = min(n_records, len(rel) - self._win_pos)
+        r = rel[self._win_pos : self._win_pos + take]
+        if size is None:
+            # uniform-stride window: rel holds row indices into the 2D
+            # buffer; flatten the view and expand to byte offsets
+            stride = buf.shape[1]
+            starts = r.astype(np.int64) * stride
+            sizes = np.full(take, stride, dtype=np.int64)
+            out = (buf.reshape(-1), starts, sizes)
+        else:
+            s = size[self._win_pos : self._win_pos + take]
+            out = (buf, r.astype(np.int64), s.astype(np.int64))
+        self._win_pos += take
+        self.records_consumed += take
+        self.records_emitted += take
+        self.gather_batches += 1
+        nbytes = int(out[2].sum())
+        self.gather_bytes += nbytes
+        _RECORDS.inc(take)
+        _GATHER_BATCHES.inc()
+        _GATHER_BYTES.inc(nbytes)
+        return out
+
+    def count_gather_fallback(self, n: int = 1) -> None:
+        """Consumers that pulled ``next_gather_batch`` views but had to
+        RE-FRAME them (native gather kernel absent in the loaded .so)
+        report it here, so ``gather_fallback_batches`` keeps its
+        meaning — 'emissions that paid the framed-bytes copy' — across
+        layers, and a stale binary can't masquerade as the zero-copy
+        fast path in io_stats/telemetry."""
+        self.gather_fallback_batches += n
+        _GATHER_FALLBACK.inc(n)
+
     def io_stats(self) -> Dict[str, object]:
         """I/O-shape counters, cumulative since construction: ``spans``
         positioned reads issued, ``seeks`` stream seek() calls (0 on
@@ -1325,6 +1639,13 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             "bytes_read": self.bytes_read,
             **_retry.stats_delta(self._retry_snap),
         }
+        if self.windowed:
+            # gather-emission shape: batches/bytes handed out zero-copy
+            # vs emissions that fell back to the framed-bytes gather
+            # (generic parsers, native kernel absent) — docs/shuffle.md
+            out["gather_batches"] = self.gather_batches
+            out["gather_bytes"] = self.gather_bytes
+            out["gather_fallback_batches"] = self.gather_fallback_batches
         if self._compressed:
             # decoded-block cache shape: hits ≫ misses on a second epoch
             # proves each block decompressed once (DMLC_DECODE_CACHE_MB)
@@ -1334,11 +1655,13 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
 
     def next_batch_ex(self, n_records: int) -> Optional[bytes]:
         """Reference NextBatchEx (indexed_recordio_split.cc:159-212):
-        record-shuffled = per-record seeks; batch-shuffled = one
-        coalesced seek per permuted span; window-shuffled = coalesced
-        spans refilling a client-side shuffle buffer (readahead thread);
+        every shuffle mode (record/batch/window) = coalesced spans
+        refilling a client-side shuffle buffer (readahead thread) with
+        one vectorized re-framing gather per emission — the NumPy
+        fallback to ``next_gather_batch``; legacy record mode =
+        per-record seeks (the reference's literal loop, kept for A/B);
         sequential = one span."""
-        if self.shuffle_mode == "window":
+        if self.windowed:
             n = self._n_overflow or n_records
             got, chunks = self._emit_from_window(n)
             if not got:
@@ -1346,30 +1669,11 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             self._n_overflow = n - got
             self.records_consumed += got
             self.records_emitted += got
+            self.gather_fallback_batches += 1
             _RECORDS.inc(got)
+            _GATHER_FALLBACK.inc()
             return chunks[0] if len(chunks) == 1 else b"".join(chunks)
-        if self.shuffle_mode == "batch":
-            if self._current >= len(self._permutation):
-                return None
-            s = self._permutation[self._current]
-            self._current += 1
-            e = min(s + self.batch_size, self.index_end)
-            if self._compressed:
-                chunk = self._emit_range(s, e)
-            else:
-                begin_off = self._index[s][0]
-                end_off = (
-                    self._index[e][0]
-                    if e < len(self._index)
-                    else self.file_offset[-1]
-                )
-                chunk = self._read_at(begin_off, end_off - begin_off)
-            if chunk:
-                self.records_consumed += e - s
-                self.records_emitted += e - s
-                _RECORDS.inc(e - s)
-            return chunk if chunk else None
-        if self.shuffle:
+        if self._legacy_record:
             n = self._n_overflow or n_records
             parts: List[bytes] = []
             while len(parts) < n and self._current < len(self._permutation):
@@ -1377,8 +1681,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 if self._compressed:
                     parts.append(self._emit_range(idx, idx + 1))
                 else:
-                    off, size = self._index[idx]
-                    parts.append(self._read_at(off, size))
+                    parts.append(
+                        self._read_at(
+                            int(self._index_offs[idx]),
+                            int(self._index_sizes[idx]),
+                        )
+                    )
                 self._current += 1
             if not parts:
                 return None
@@ -1395,10 +1703,10 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         if self._compressed:
             chunk = self._emit_range(self._current, last)
         else:
-            begin_off = self._index[self._current][0]
+            begin_off = int(self._index_offs[self._current])
             end_off = (
-                self._index[last][0]
-                if last < len(self._index)
+                int(self._index_offs[last])
+                if last < len(self._index_offs)
                 else self.file_offset[-1]
             )
             chunk = self._read_at(begin_off, end_off - begin_off)
@@ -1803,6 +2111,9 @@ def create(
             window = uri_int(spec.args, "window", 65536, minimum=1)
         if merge_gap is None:
             merge_gap = uri_int(spec.args, "merge_gap", 65536, minimum=0)
+        # &legacy_shuffle=1: force the reference's per-record seek loop
+        # for shuffle=record (A/B baseline against the gather fast path)
+        legacy_shuffle = bool(uri_int(spec.args, "legacy_shuffle", 0))
         # data-position resume sugar (?epoch=E&skip_records=N): start at
         # epoch E's deterministic permutation, N records in (§5.4)
         if epoch == 0:
@@ -1854,6 +2165,7 @@ def create(
             # default), so they are never None here
             window=window,  # type: ignore[arg-type]
             merge_gap=merge_gap,  # type: ignore[arg-type]
+            legacy_shuffle=legacy_shuffle,
         )
     else:
         raise Error(f"unknown InputSplit type {type!r}")
@@ -1874,13 +2186,12 @@ def create(
         # cached OR threaded, never both: CachedInputSplit prefetches
         # internally (reference io.cc:119-124 chooses exactly one wrapper)
         return CachedInputSplit(base, spec.cache_file)
-    if (
-        isinstance(base, IndexedRecordIOSplitter)
-        and base.shuffle_mode == "window"
-    ):
-        # window mode already prefetches on its own readahead thread
-        # (coalesced spans for window k+1 load while k drains); stacking
-        # a ThreadedInputSplit would add a queue without overlap
+    if isinstance(base, IndexedRecordIOSplitter) and base.windowed:
+        # every unified-path shuffle mode (record/batch/window) already
+        # prefetches on its own readahead thread (coalesced spans for
+        # window k+1 load while k drains); stacking a ThreadedInputSplit
+        # would add a queue without overlap — and would hide
+        # next_gather_batch from the fused consumer
         return base
     if threaded:
         return ThreadedInputSplit(base)

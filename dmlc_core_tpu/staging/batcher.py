@@ -31,6 +31,7 @@ __all__ = [
     "BatchSpec",
     "FixedShapeBatcher",
     "alloc_packed_slot",
+    "gather_slices",
     "packed_shard_layout",
 ]
 
@@ -64,6 +65,30 @@ def alloc_packed_slot(sections):
     for (o, nb), (name, shape, dtype) in zip(offs, sections):
         views[name] = buf[o : o + nb].view(dtype).reshape(shape)
     return buf, views
+
+
+def gather_slices(
+    buf: np.ndarray, starts: np.ndarray, sizes: np.ndarray
+) -> np.ndarray:
+    """Vectorized byte gather: one contiguous uint8 array holding
+    ``buf[starts[i] : starts[i] + sizes[i]]`` back to back, via
+    ``np.repeat`` index expansion — no per-slice Python loop.
+
+    The NumPy fallback for the shuffled-read gather handoff
+    (``IndexedRecordIOSplitter.next_gather_batch``) when the native
+    gather kernel is absent: the re-framed result feeds the sequential
+    chunk parsers unchanged (staging/fused.py).
+    """
+    starts = np.asarray(starts, dtype=np.int64)
+    sizes = np.asarray(sizes, dtype=np.int64)
+    total = int(sizes.sum())
+    if not total:
+        return np.empty(0, dtype=np.uint8)
+    base = np.cumsum(sizes) - sizes
+    gather = np.arange(total, dtype=np.int64) + np.repeat(
+        starts - base, sizes
+    )
+    return buf[gather]
 
 
 def packed_shard_layout(entries, n_shards: int):

@@ -54,7 +54,7 @@ from ..io.recordio import CFLAG_COMPRESSED, KMAGIC, decode_flag
 from ..io.uri import URISpec, rejoin_query, uri_int
 from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error, check
-from .batcher import Batch, BatchSpec, alloc_packed_slot
+from .batcher import Batch, BatchSpec, alloc_packed_slot, gather_slices
 
 # registry mirrors of the per-producer counters (the per-instance
 # attributes stay authoritative for io_stats(); these give the fleet
@@ -554,10 +554,15 @@ class FusedEllRowRecBatches(_EllSlotMixin):
     partial record, so no boundary pre-scan is needed); sharded/remote URIs
     go through RecordIOSplitter chunks (record-aligned byte-range sharding,
     reference src/io/recordio_split.cc). Shuffled-epoch reads ride the URI
-    sugar (``?index=<uri>&shuffle=record|batch|window``); the window mode
-    (coalesced spans + readahead, io/split.py) keeps full per-record
-    randomness at near-sequential read cost, and ``io_stats()`` exposes
-    the split's seek/span counters so the I/O shape is observable.
+    sugar (``?index=<uri>&shuffle=record|batch|window``) and take the
+    GATHER fast path: the windowed split (coalesced spans + readahead,
+    io/split.py) hands ``(buf, starts, sizes)`` batch views and the
+    native gather kernel parses records straight out of the window
+    buffer in permutation order — full per-record randomness at
+    near-sequential read cost with zero per-record Python
+    (``&legacy_shuffle=1`` forces the reference's per-record seek loop
+    for A/B). ``io_stats()`` exposes the split's seek/span/gather
+    counters so the I/O shape is observable.
 
     A yielded batch stays valid until ``ring_slots - 1`` further batches
     have been produced.
@@ -623,6 +628,13 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         self.rows_out = 0
         self.truncated_nnz = 0
         self.bad_records = 0
+        # shuffled gather fast path: a windowed shuffle split
+        # (shuffle=record/batch/window, io/split.py) hands whole
+        # batches as (buf, starts, sizes) views — parsed straight out
+        # of the window buffer by the native gather kernel, no
+        # per-record Python and no re-framing copy
+        sg = getattr(self._split, "supports_gather", None)
+        self._gather = bool(sg is not None and sg())
 
     def io_stats(self):
         """Counters from the underlying split — seek/span shape on
@@ -666,6 +678,9 @@ class FusedEllRowRecBatches(_EllSlotMixin):
         if self._mmap:
             yield from self._iter_mmap()
             return
+        if self._gather:
+            yield from self._iter_gather()
+            return
         carry = b""
         while True:
             chunk = self._split.next_chunk()
@@ -693,6 +708,71 @@ class FusedEllRowRecBatches(_EllSlotMixin):
                 "rowrec: truncated RecordIO stream "
                 f"({len(carry)} undecodable trailing bytes)"
             )
+        if fill:
+            yield from self._tail(fill)
+
+    def _iter_gather(self) -> Iterator[Batch]:
+        """Shuffled gather fast path (docs/shuffle.md): the windowed
+        split emits ``(buf, starts, sizes)`` — span bytes plus
+        per-record offsets in permutation order — and the native gather
+        kernel parses every record straight out of the window buffer
+        into the ring slot: ONE native call per batch, no per-record
+        Python, no re-framing memcpy. When the loaded .so predates the
+        gather entry point, the batch is re-framed with one vectorized
+        numpy gather (``gather_slices``) and fed to the sequential
+        chunk kernel instead — same rows, one extra copy."""
+        B = self.spec.batch_size
+        fill = 0
+        use_native = native.HAS_GATHER_ELL
+        while True:
+            g = self._split.next_gather_batch(B - fill)
+            if g is None:
+                break
+            buf, starts, sizes = g
+            if not use_native:
+                self._split.count_gather_fallback()
+                chunk = gather_slices(buf, starts, sizes)
+                off, fill, progressed = self._feed(chunk, 0, fill)
+                check(
+                    progressed and off == len(chunk),
+                    "rowrec: truncated record in shuffled gather batch "
+                    "(index and data disagree)",
+                )
+                if fill == B:
+                    yield self._emit(self._ring[self._slot], B)
+                    self._slot = (self._slot + 1) % len(self._ring)
+                    fill = 0
+                continue
+            off, n = 0, len(starts)
+            while off < n:
+                slot = self._ring[self._slot]
+                indices, values, nnz, labels, weights, _packed = slot
+                rows, consumed, trunc, bad, corrupt = (
+                    native.parse_rowrec_gather_ell(
+                        buf, starts, sizes, off, n - off,
+                        indices, values, nnz, labels, weights, fill,
+                    )
+                )
+                self.rows_in += rows
+                self.truncated_nnz += trunc
+                self.bad_records += bad
+                if trunc:
+                    _TRUNCATED.inc(trunc)
+                if bad:
+                    _BAD_RECORDS.inc(bad)
+                if corrupt:
+                    raise Error(
+                        "rowrec: corrupt RecordIO frame in shuffled "
+                        f"gather slice {off + consumed} (the index and "
+                        "the data disagree)"
+                    )
+                check(consumed > 0 or rows > 0, "gather made no progress")
+                off += consumed
+                fill += rows
+                if fill == B:
+                    yield self._emit(slot, B)
+                    self._slot = (self._slot + 1) % len(self._ring)
+                    fill = 0
         if fill:
             yield from self._tail(fill)
 

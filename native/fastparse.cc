@@ -1027,6 +1027,21 @@ inline bool parse_label_token(const char** pp, const char* le, EllState& st,
   return true;
 }
 
+// f32 row -> f16 row (RNE), 8-wide where F16C is available.
+inline void f32row_to_f16(const char* src, uint16_t* dst, int64_t n) {
+  int64_t i = 0;
+#if defined(__F16C__) && defined(__AVX__)
+  for (; i + 8 <= n; i += 8) {
+    const __m256 v =
+        _mm256_loadu_ps(reinterpret_cast<const float*>(src) + i);
+    _mm_storeu_si128(
+        reinterpret_cast<__m128i*>(dst + i),
+        _mm256_cvtps_ph(v, _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC));
+  }
+#endif
+  for (; i < n; ++i) dst[i] = f32_to_f16(load_f32(src + i * 4));
+}
+
 // Decode one rowrec payload into ELL row `row`. Returns false on a
 // malformed payload (declared sizes exceed the payload).
 inline bool rowrec_to_ell(const char* p, int64_t len, EllState& st,
@@ -1045,35 +1060,102 @@ inline bool rowrec_to_ell(const char* p, int64_t len, EllState& st,
   const int64_t keep = std::min<int64_t>(n, st.K);
   st.truncated += static_cast<int64_t>(n) - keep;
   int32_t* irow = st.indices + row * st.K;
-  int64_t kept = 0;
+  // bulk copy then scan for unfit ids (sign bit set after the uint32
+  // reinterpret) — the no-bad-id case, i.e. every real dataset, stays a
+  // memcpy plus one vectorizable scan instead of a branch per feature
+  std::memcpy(irow, idx, static_cast<size_t>(keep) * 4);
+  std::memset(irow + keep, 0, static_cast<size_t>(st.K - keep) * 4);
+  bool any_bad = false;
   for (int64_t i = 0; i < keep; ++i) {
-    const uint32_t u = load_u32(idx + i * 4);
-    if (u > 0x7fffffffu) {
-      irow[i] = 0;
-      ++st.truncated;
-    } else {
-      irow[i] = static_cast<int32_t>(u);
-      ++kept;
+    if (irow[i] < 0) {
+      any_bad = true;
+      break;
     }
   }
-  std::memset(irow + keep, 0, static_cast<size_t>(st.K - keep) * 4);
+  int64_t kept = keep;
+  if (any_bad) {
+    kept = 0;
+    for (int64_t i = 0; i < keep; ++i) {
+      if (irow[i] < 0) {
+        irow[i] = 0;
+        ++st.truncated;
+      } else {
+        ++kept;
+      }
+    }
+  }
   if (st.f16) {
     uint16_t* vrow = static_cast<uint16_t*>(st.values) + row * st.K;
-    for (int64_t i = 0; i < keep; ++i) {
-      const uint32_t u = load_u32(idx + i * 4);
-      vrow[i] = u > 0x7fffffffu ? 0 : f32_to_f16(load_f32(val + i * 4));
-    }
+    f32row_to_f16(val, vrow, keep);
     std::memset(vrow + keep, 0, static_cast<size_t>(st.K - keep) * 2);
+    if (any_bad) {
+      for (int64_t i = 0; i < keep; ++i) {
+        if (load_u32(idx + i * 4) > 0x7fffffffu) vrow[i] = 0;
+      }
+    }
   } else {
     float* vrow = static_cast<float*>(st.values) + row * st.K;
     std::memcpy(vrow, val, static_cast<size_t>(keep) * 4);
-    for (int64_t i = 0; i < keep; ++i) {
-      if (load_u32(idx + i * 4) > 0x7fffffffu) vrow[i] = 0.0f;
+    if (any_bad) {
+      for (int64_t i = 0; i < keep; ++i) {
+        if (load_u32(idx + i * 4) > 0x7fffffffu) vrow[i] = 0.0f;
+      }
     }
     std::memset(vrow + keep, 0, static_cast<size_t>(st.K - keep) * 4);
   }
   st.nnz[row] = static_cast<int32_t>(kept);
   return true;
+}
+
+// Walk ONE logical record (a standalone frame or a multi-part chain)
+// starting at *pp within [*pp, end). On success advances *pp past it and
+// sets payload/plen (chains reassembled into `chain` with the elided
+// magic re-inserted, reference recordio.cc:63-77). Returns 1 complete,
+// 0 incomplete (partial header/payload hits `end` — trailing partial,
+// not an error), -1 corrupt (a full header is in view but carries no
+// magic: the stream is broken HERE, callers fail fast). Shared by the
+// sequential chunk kernel and the shuffled gather kernel so the frame
+// semantics cannot drift between them.
+inline int walk_one_record(const char** pp, const char* end,
+                           std::vector<char>& chain, const char** payload,
+                           int64_t* plen) {
+  const char* p = *pp;
+  chain.clear();
+  bool in_chain = false;
+  while (true) {
+    if (end - p < 8) return 0;  // partial header
+    if (load_u32(p) != kRecMagic) return -1;
+    const uint32_t lrec = load_u32(p + 4);
+    const uint32_t cflag = (lrec >> 29) & 7u;
+    const int64_t pl = static_cast<int64_t>(lrec & ((1u << 29) - 1u));
+    const int64_t upper = (pl + 3) & ~int64_t{3};
+    if (end - p < 8 + upper) return 0;  // partial payload
+    const char* data = p + 8;
+    p += 8 + upper;
+    if (cflag == 0) {
+      // complete standalone record; if a chain was pending this abandons
+      // it, matching RecordIOChunkReader.next_record (io/recordio.py)
+      *payload = data;
+      *plen = pl;
+      *pp = p;
+      return 1;
+    }
+    // multi-part chain: parts are joined with the elided magic word
+    // re-inserted between them
+    if (in_chain) {
+      const char m[4] = {'\x0a', '\x23', '\xd7', '\xce'};  // LE kRecMagic
+      chain.insert(chain.end(), m, m + 4);
+    }
+    chain.insert(chain.end(), data, data + pl);
+    in_chain = true;
+    if (cflag == 3) {
+      *payload = chain.data();
+      *plen = static_cast<int64_t>(chain.size());
+      *pp = p;
+      return 1;
+    }
+    // cflag 1 or 2: chain continues with the next frame
+  }
 }
 
 }  // namespace
@@ -1103,55 +1185,12 @@ DMLC_API void dmlc_parse_rowrec_ell(
   std::vector<char> chain;  // reassembly buffer for multi-part records
   const char* consumed_to = buf;
   while (row < row_capacity) {
-    // walk one record (possibly a multi-part chain) starting at p
     const char* rec_start = p;
-    chain.clear();
-    bool in_chain = false;
-    bool complete = false;
     const char* payload = nullptr;
     int64_t payload_len = 0;
-    while (true) {
-      if (end - p < 8) break;  // partial header: stop at rec_start
-      const uint32_t magic = load_u32(p);
-      if (magic != kRecMagic) {
-        // full header available but no magic: corrupt, not partial —
-        // flag it so the caller fails fast instead of accumulating the
-        // rest of the shard as carry (ADVICE r3)
-        corrupt = true;
-        break;
-      }
-      const uint32_t lrec = load_u32(p + 4);
-      const uint32_t cflag = (lrec >> 29) & 7u;
-      const int64_t plen = static_cast<int64_t>(lrec & ((1u << 29) - 1u));
-      const int64_t upper = (plen + 3) & ~int64_t{3};
-      if (end - p < 8 + upper) break;  // partial payload
-      const char* data = p + 8;
-      p += 8 + upper;
-      if (cflag == 0) {
-        // complete standalone record; if a chain was pending this abandons
-        // it, matching RecordIOChunkReader.next_record (io/recordio.py)
-        payload = data;
-        payload_len = plen;
-        complete = true;
-        break;
-      }
-      // multi-part chain: parts are joined with the elided magic word
-      // re-inserted between them (reference recordio.cc:63-77)
-      if (in_chain) {
-        const char m[4] = {'\x0a', '\x23', '\xd7', '\xce'};  // LE kRecMagic
-        chain.insert(chain.end(), m, m + 4);
-      }
-      chain.insert(chain.end(), data, data + plen);
-      in_chain = true;
-      if (cflag == 3) {
-        payload = chain.data();
-        payload_len = static_cast<int64_t>(chain.size());
-        complete = true;
-        break;
-      }
-      // cflag 1 or 2: chain continues with the next frame
-    }
-    if (!complete) {
+    const int got = walk_one_record(&p, end, chain, &payload, &payload_len);
+    if (got <= 0) {
+      if (got < 0) corrupt = true;  // bad magic with a full header: fail fast
       p = rec_start;  // leave the partial chain for the caller's next window
       break;
     }
@@ -1164,6 +1203,59 @@ DMLC_API void dmlc_parse_rowrec_ell(
   }
   out->rows_written = row - row_start;
   out->bytes_consumed = consumed_to - buf;
+  out->truncated = st.truncated;
+  out->bad_records = bad;
+  out->corrupt = corrupt ? 1 : 0;
+}
+
+// -- shuffled-read gather: (buf, starts, sizes) -> ELL batch ------------------
+//
+// The shuffled fast path (docs/shuffle.md): IndexedRecordIOSplitter's
+// window machinery hands `next_gather_batch` views — one decoded span
+// buffer plus per-record byte offsets/lengths IN PERMUTATION ORDER — and
+// this kernel parses every record straight out of the window buffer into
+// the caller's ring-slot ELL batch. One native call per batch replaces
+// the per-record Python loop AND the re-framing memcpy of the bytes
+// fallback; combined with the packed ring slots the shuffled epoch rides
+// the same single-DMA staging path as sequential reads.
+//
+// Each (starts[i], sizes[i]) slice must contain one whole logical record
+// (a frame or a multi-part chain — the index points at chain starts). A
+// slice that doesn't (bad magic OR a record extending past the slice)
+// means the index and the data disagree: reported as `corrupt`, and the
+// caller fails fast. Malformed rowrec payloads are skipped and counted in
+// `bad_records`, exactly like the sequential kernel. Stops at
+// buffer-full; `bytes_consumed` carries the number of RECORDS consumed
+// (slices, not bytes — the caller resumes at starts[consumed]).
+
+DMLC_API void dmlc_parse_rowrec_gather_ell(
+    const char* buf, const int64_t* starts, const int64_t* sizes,
+    int64_t n_recs, int64_t max_nnz, int32_t out_f16, int32_t* indices,
+    void* values, int32_t* nnz, float* labels, float* weights,
+    int64_t row_start, int64_t row_capacity, EllResult* out) {
+  EllState st{indices, values, nnz, labels, weights, max_nnz, out_f16 != 0, 0};
+  int64_t row = row_start;
+  int64_t bad = 0;
+  int64_t i = 0;
+  bool corrupt = false;
+  std::vector<char> chain;
+  for (; i < n_recs && row < row_capacity; ++i) {
+    const char* p = buf + starts[i];
+    const char* end = p + sizes[i];
+    const char* payload = nullptr;
+    int64_t payload_len = 0;
+    if (walk_one_record(&p, end, chain, &payload, &payload_len) <= 0) {
+      corrupt = true;  // slice holds no complete record: index mismatch
+      break;
+    }
+    if (rowrec_to_ell(payload, payload_len, st, row)) {
+      ++row;
+    } else {
+      ++bad;
+    }
+  }
+  out->rows_written = row - row_start;
+  out->bytes_consumed = i;  // gather contract: records consumed, not bytes
   out->truncated = st.truncated;
   out->bad_records = bad;
   out->corrupt = corrupt ? 1 : 0;
@@ -1381,6 +1473,73 @@ DMLC_API void dmlc_parse_libsvm_ell(
       });
   out->truncated = st.truncated;
   out->has_cr = has_cr ? 1 : 0;
+}
+
+// -- CPython-compatible shuffle ----------------------------------------------
+//
+// Fisher-Yates over an int64 array, reproducing random.Random.shuffle
+// BIT-IDENTICALLY from a CPython Mersenne-Twister state snapshot
+// (random.Random.getstate()): same genrand_uint32 stream, same tempering,
+// same getrandbits(k)=top-k-bits rule, same rejection loop, same swap
+// order. The shuffled-read permutation contract (docs/shuffle.md) pins
+// epoch order to random.Random(seed', epoch'), which costs ~1.4 us/record
+// in the interpreter — this native twin keeps the ORDER while removing the
+// Python loop from the epoch's critical path (io/split.py falls back to
+// random.shuffle when the kernel is absent; parity enforced by
+// tests/test_native.py).
+
+namespace {
+
+struct Mt19937 {
+  uint32_t mt[624];
+  int mti;
+
+  inline uint32_t next() {
+    if (mti >= 624) {
+      // one-pass in-place regeneration; the modular indices resolve to
+      // the reference implementation's three loops (already-updated
+      // words are read exactly where CPython reads them)
+      for (int kk = 0; kk < 624; ++kk) {
+        const uint32_t y =
+            (mt[kk] & 0x80000000u) | (mt[(kk + 1) % 624] & 0x7fffffffu);
+        mt[kk] =
+            mt[(kk + 397) % 624] ^ (y >> 1) ^ ((y & 1u) ? 0x9908b0dfu : 0u);
+      }
+      mti = 0;
+    }
+    uint32_t y = mt[mti++];
+    y ^= y >> 11;
+    y ^= (y << 7) & 0x9d2c5680u;
+    y ^= (y << 15) & 0xefc60000u;
+    y ^= y >> 18;
+    return y;
+  }
+};
+
+}  // namespace
+
+// `state` is the 624-word key and `mti` the position from
+// random.Random.getstate() (state[1][:624], state[1][624]). `n` must be
+// < 2^31 (the Python wrapper falls back beyond that: getrandbits(k>32)
+// consumes multiple words per call and is not worth mirroring).
+DMLC_API void dmlc_shuffle_mt19937(const uint32_t* state, int32_t mti,
+                                   int64_t n, int64_t* x) {
+  Mt19937 rng;
+  std::memcpy(rng.mt, state, sizeof(rng.mt));
+  rng.mti = mti;
+  for (int64_t i = n - 1; i >= 1; --i) {
+    const uint32_t bound = static_cast<uint32_t>(i + 1);
+    int k = 0;
+    while ((bound >> k) != 0u) ++k;  // k = bit_length(i + 1) <= 31
+    uint32_t r;
+    do {
+      r = rng.next() >> (32 - k);  // getrandbits(k): top k bits
+    } while (r >= bound);
+    const int64_t j = static_cast<int64_t>(r);
+    const int64_t tmp = x[i];
+    x[i] = x[j];
+    x[j] = tmp;
+  }
 }
 
 // Build stamp: the Makefile passes -DDMLC_SRC_HASH="sha256 of fastparse.cc"

@@ -18,6 +18,48 @@ if "xla_force_host_platform_device_count" not in flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _refresh_native() -> None:
+    """`make native` staleness gate (ISSUE 6 satellite): when
+    native/fastparse.cc is newer than the prebuilt .so, rebuild BEFORE
+    anything imports dmlc_core_tpu.data.native — otherwise the native
+    parity suites (and every fused-kernel test) silently validate last
+    round's binary. No toolchain → skip the rebuild with a visible
+    reason on stderr; the source-hash stamp still flags the stale .so
+    wherever it matters (bench.ensure_native refuses it outright)."""
+    import shutil
+    import subprocess
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(root, "native", "fastparse.cc")
+    so = os.path.join(root, "native", "libdmlc_tpu_native.so")
+    if not os.path.exists(src):
+        return
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return
+    make = shutil.which("make")
+    cxx = shutil.which(os.environ.get("CXX", "g++"))
+    if not make or not cxx:
+        sys.stderr.write(
+            "[conftest] SKIPPING native rebuild: fastparse.cc is newer "
+            "than libdmlc_tpu_native.so but no make/g++ toolchain is "
+            "available — native suites run against the existing binary\n"
+        )
+        return
+    proc = subprocess.run(
+        [make, "-C", os.path.join(root, "native")],
+        capture_output=True,
+        text=True,
+    )
+    if proc.returncode != 0:
+        sys.stderr.write(
+            "[conftest] native rebuild FAILED; tests run against the "
+            "stale binary:\n" + (proc.stdout + proc.stderr)[-2000:] + "\n"
+        )
+
+
+_refresh_native()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns subprocesses with fresh jax imports"

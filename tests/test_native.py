@@ -172,3 +172,107 @@ def test_no_native_fallback_env(tmp_path):
         check=True,
         env={"DMLC_TPU_NO_NATIVE": "1", "PATH": "/usr/bin:/bin"},
     )
+
+
+def test_shuffle_mt19937_parity_with_random_random():
+    """The native Fisher-Yates must replay random.Random.shuffle
+    BIT-IDENTICALLY (same MT draws, same rejection loop, same swaps) —
+    the shuffled-read permutation contract hangs on it."""
+    import random
+
+    if not native.HAS_SHUFFLE:
+        pytest.skip("shuffle kernel not loaded")
+    for seed in (0, 1, 7, 111, 10**9):
+        for n in (0, 1, 2, 3, 9, 17, 255, 256, 257, 1024, 9999):
+            ref = list(range(n))
+            random.Random(seed).shuffle(ref)
+            perm = np.arange(n, dtype=np.int64)
+            assert native.shuffle_mt19937(random.Random(seed), perm)
+            assert perm.tolist() == ref, (seed, n)
+    # the empty permutation is a no-op, not a refusal
+    assert native.shuffle_mt19937(
+        random.Random(1), np.empty(0, dtype=np.int64)
+    )
+    # oversize permutations REFUSE (CPython's getrandbits consumes
+    # multiple MT words per call beyond 2^31, which the kernel does not
+    # mirror — silent order divergence if this guard rots). A
+    # zero-stride view fakes the length without 16 GB of memory; the
+    # size check must fire before anything touches the buffer.
+    big = np.lib.stride_tricks.as_strided(
+        np.zeros(1, dtype=np.int64), shape=(1 << 31,), strides=(0,)
+    )
+    assert not native.shuffle_mt19937(random.Random(1), big)
+
+
+def test_rowrec_gather_kernel_matches_sequential_kernel():
+    """The gather entry point must decode the same records the
+    sequential chunk kernel does — including multi-part chains,
+    truncated-feature counting, and bad-payload skipping."""
+    import struct
+
+    if not (native.HAS_ELL and native.HAS_GATHER_ELL):
+        pytest.skip("ELL kernels not loaded")
+    from dmlc_core_tpu.io.recordio import RecordIOWriter
+    from dmlc_core_tpu.io.stream import MemoryStream
+
+    rng = np.random.default_rng(4)
+    KMAGIC = 0xCED7230A
+    payloads = []
+    for i in range(40):
+        n = int(rng.integers(0, 6))
+        idx = rng.integers(0, 1000, n).astype("<u4")
+        if i == 7:
+            idx = idx.copy()
+            if n:
+                idx[0] = 0x80000001  # unfit id: zeroed + truncated
+        val = rng.normal(size=n).astype("<f4")
+        payloads.append(
+            struct.pack("<ffI", float(i), 1.0, n)
+            + idx.tobytes() + val.tobytes()
+        )
+    # one payload containing the magic word at an aligned offset → the
+    # writer emits a multi-part chain
+    payloads.append(
+        struct.pack("<ffI", 99.0, 1.0, 2)
+        + struct.pack("<II", KMAGIC, 5)
+        + np.ones(2, "<f4").tobytes()
+    )
+    ms = MemoryStream()
+    w = RecordIOWriter(ms)
+    starts = []
+    for p in payloads:
+        starts.append(ms.tell())
+        w.write_record(p)
+    data = np.frombuffer(ms.getvalue(), dtype=np.uint8)
+    st = np.asarray(starts, dtype=np.int64)
+    sz = np.diff(np.r_[st, len(data)]).astype(np.int64)
+    B, K = len(payloads) + 3, 4
+
+    def alloc():
+        return (
+            np.zeros((B, K), np.int32),
+            np.zeros((B, K), np.float32),
+            np.zeros(B, np.int32),
+            np.zeros(B, np.float32),
+            np.zeros(B, np.float32),
+        )
+
+    seq = alloc()
+    r1 = native.parse_rowrec_ell(data.tobytes(), 0, *seq, 0)
+    gat = alloc()
+    r2 = native.parse_rowrec_gather_ell(data, st, sz, 0, len(st), *gat, 0)
+    assert r1[0] == r2[0] == len(payloads)  # rows written
+    assert r1[2] == r2[2] > 0  # truncated (unfit id + beyond-K)
+    assert r1[3] == r2[3] == 0
+    assert r1[4] == r2[4] == 0
+    for a, b in zip(seq, gat):
+        np.testing.assert_array_equal(a, b)
+    # permuted slices decode in slice order
+    perm = rng.permutation(len(st))
+    gat2 = alloc()
+    native.parse_rowrec_gather_ell(
+        data, st[perm].copy(), sz[perm].copy(), 0, len(st), *gat2, 0
+    )
+    np.testing.assert_array_equal(
+        gat2[3][: len(st)], seq[3][: len(st)][perm]
+    )

@@ -488,3 +488,59 @@ def test_fractional_quota_still_gets_one_thread(monkeypatch):
 
     monkeypatch.setattr(cpus, "cgroup_cpu_quota", lambda: 0.4)
     assert cpus.available_cpus() >= 1
+
+
+def test_shuffled_gather_batches_ride_packed_shard_dma(tmp_path):
+    """ISSUE 6 acceptance: shuffled batches (gather fast path) land on
+    the packed-shard mesh path — packed_shard_dma latches True, one u8
+    put per addressable device, zero per-array fallbacks — with device
+    values bit-identical to the legacy per-record shuffle staged the
+    same way."""
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream
+    from dmlc_core_tpu.data.row_block import RowBlock
+    from dmlc_core_tpu.data.rowrec import encode_rows
+
+    rng = np.random.default_rng(13)
+    k = 4
+    blk = RowBlock(
+        offset=np.arange(N_ROWS + 1, dtype=np.int64) * k,
+        label=(np.arange(N_ROWS) % 2).astype(np.float32),
+        index=rng.integers(0, 32, N_ROWS * k).astype(np.uint32),
+        value=rng.normal(size=N_ROWS * k).astype(np.float32),
+    )
+    rec = str(tmp_path / "sh.rec")
+    idx = str(tmp_path / "sh.idx")
+    with FileStream(rec, "w") as d, FileStream(idx, "w") as i:
+        w = IndexedRecordIOWriter(d, i)
+        for payload in encode_rows(blk):
+            w.write_record(payload)
+    spec = BatchSpec(batch_size=BATCH_ROWS, layout="ell", max_nnz=k)
+    mesh = _mesh((4, 2), ("data", "model"))
+
+    def staged(sugar=""):
+        stream = ell_batches(
+            f"{rec}?index={idx}&shuffle=record&seed=3{sugar}", spec
+        )
+        pipe = StagingPipeline(stream, mesh=mesh, data_axis="data")
+        out = [
+            {kk: np.asarray(v) for kk, v in dev.items()} for dev in pipe
+        ]
+        st = pipe.staging_stats()
+        io = pipe.io_stats()
+        drain_close(pipe, stream)
+        return out, st, io
+
+    got, st, io = staged()
+    assert st["packed_shard_dma"] is True
+    assert st["per_array_batches"] == 0
+    assert st["packed_shard_batches"] == 3
+    assert st["device_puts"] == 3 * 8  # one u8 DMA per device per batch
+    assert io.get("gather_batches", 0) > 0
+    assert io.get("gather_fallback_batches") == 0
+    ref, _st, _io = staged("&legacy_shuffle=1")
+    assert len(got) == len(ref) == 3
+    for a, b in zip(got, ref):
+        assert set(a) == set(b)
+        for key in b:
+            np.testing.assert_array_equal(a[key], b[key], err_msg=key)
