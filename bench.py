@@ -339,8 +339,15 @@ def _make_rec_stream(value_dtype: str):
 
 
 REC_INDEX = REC_DATA + ".idx"
+# 1 MB compressed blocks (vs the 256 KB writer default): the right
+# packing for a sequential-epoch corpus — better ratio, fewer block
+# headers, and per-block costs (decode dispatch, shared-cache segment
+# attach) amortize over 4x the payload. The filename carries the block
+# size so a packing change can never silently reuse stale data.
+REC_ZLIB_BLOCK = 1 << 20
 REC_ZLIB_DATA = os.environ.get(
-    "BENCH_REC_ZLIB_DATA", f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.zlib.rec"
+    "BENCH_REC_ZLIB_DATA",
+    f"/tmp/dmlc_tpu_bench_criteo_{REC_ROWS}.zlib1m.rec",
 )
 REC_ZLIB_INDEX = REC_ZLIB_DATA + ".idx"
 
@@ -363,7 +370,9 @@ def ensure_rec_zlib_data() -> None:
     with open(REC_DATA, "rb") as src, FileStream(tmp, "w") as f, FileStream(
         tmpi, "w"
     ) as fi:
-        w = IndexedRecordIOWriter(f, fi, codec="zlib")
+        w = IndexedRecordIOWriter(
+            f, fi, codec="zlib", block_bytes=REC_ZLIB_BLOCK
+        )
         while True:
             buf = src.read(stride * 4096)
             if not buf:
@@ -772,6 +781,118 @@ def run_series(tasks, rounds: int, probe: "LinkProbe"):
     return results
 
 
+def _shared_cache_drain_main(rec: str, idx: str) -> None:
+    """Worker mode (``python bench.py --shared-cache-drain rec idx``):
+    drain one compressed indexed shard host-side through the split
+    layer and print one JSON line — rows, secs, this process's decode
+    count and shared-tier hits. The parent runs it as a REAL separate
+    process so the two-level lookup behaves exactly as N colocated
+    trainers would (per-process L1, shared daemon L2 via
+    DMLC_BLOCK_CACHE_SOCK / DMLC_BLOCK_CACHE in the environment)."""
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.telemetry import default_registry
+
+    t0 = time.perf_counter()
+    sp = io_split.IndexedRecordIOSplitter(rec, idx, 0, 1)
+    t1 = time.perf_counter()
+    nbytes = 0
+    # steady-state drain rate: construction (index parse — one-time,
+    # identical with and without a daemon) is reported separately so
+    # the speedup isolates what the shared tier actually changes
+    while True:
+        chunk = sp.next_batch_ex(16384)
+        if chunk is None:
+            break
+        nbytes += len(chunk)
+    dt = time.perf_counter() - t1
+    stats = sp.io_stats()
+    sp.close()
+    reg = default_registry()
+    print(json.dumps({
+        "rows": stats.get("records", 0),
+        "bytes": nbytes,
+        "secs": round(dt, 4),
+        "construct_secs": round(t1 - t0, 4),
+        "mb_per_sec": round(nbytes / dt / 1e6, 2),
+        "decodes": reg.histogram("io.codec.decode_seconds").snapshot()[
+            "count"
+        ],
+        "blockcache_hits": sum(
+            reg.counter_values("io.blockcache.hits").values()
+        ),
+    }))
+
+
+def _shared_cache_bench() -> dict:
+    """The ``rec_zlib_shared_cache`` config (ISSUE 7): decode-once-per-
+    host, measured with real processes. A private daemon serves a
+    job-local socket; reader 1 publishes every decoded block, reader 2
+    (the number that matters — the second colocated trainer) should
+    serve entirely from shared memory, and a control reader runs with
+    the tier forced off. ``shared_cache_speedup`` is reader 2's
+    throughput over the control's; ``daemon_hit_rate`` comes from the
+    daemon's own counters."""
+    import tempfile
+
+    from dmlc_core_tpu.io.blockcache import (
+        BlockCacheClient,
+        BlockCacheDaemon,
+    )
+
+    sock_dir = tempfile.mkdtemp(prefix="dmlc-bench-cache-")
+    sock = os.path.join(sock_dir, "cache.sock")
+    daemon = BlockCacheDaemon(sock, max_bytes=2 << 30).start()
+
+    def run(extra_env: dict) -> dict:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--shared-cache-drain", REC_ZLIB_DATA, REC_ZLIB_INDEX],
+            capture_output=True, text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu", **extra_env},
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"shared-cache drain worker failed: {out.stderr[-2000:]}"
+            )
+        return json.loads(out.stdout)
+
+    def best_of(n: int, extra_env: dict) -> dict:
+        # fastest of n runs: the drain is ~1-3s on a small shared box,
+        # where one scheduler hiccup swings a single sample 2x — the
+        # min is the least-contended (honest) reading for both sides
+        runs = [run(extra_env) for _ in range(n)]
+        return min(runs, key=lambda r: r["secs"])
+
+    # daemon-on runs pin DMLC_BLOCK_CACHE=auto so an operator-level
+    # `off` in the outer environment cannot silently measure the
+    # fallback path as the feature; the control pins `off` likewise
+    on = {"DMLC_BLOCK_CACHE": "auto", "DMLC_BLOCK_CACHE_SOCK": sock}
+    try:
+        publisher = run(on)
+        second = best_of(2, on)
+        control = best_of(2, {"DMLC_BLOCK_CACHE": "off"})
+        stats = BlockCacheClient(sock).stats() or {}
+    finally:
+        daemon.close()
+        import shutil
+
+        shutil.rmtree(sock_dir, ignore_errors=True)
+    lookups = stats.get("hits", 0) + stats.get("misses", 0)
+    return {
+        "publisher": publisher,
+        "second_reader": second,
+        "no_daemon": control,
+        "shared_cache_speedup": round(
+            control["secs"] / max(second["secs"], 1e-9), 2
+        ),
+        "daemon_hit_rate": round(
+            stats.get("hits", 0) / lookups, 4
+        ) if lookups else None,
+        "daemon_publishes": stats.get("publishes", 0),
+        "second_reader_decodes": second["decodes"],
+    }
+
+
 def _telemetry_snapshot() -> dict:
     from dmlc_core_tpu.telemetry import to_json
 
@@ -886,6 +1007,26 @@ def main() -> None:
         [r["xfer_mb_per_sec"] for r in series["rec_f32"]]
     )
 
+    # decode-once-per-host: two real reader processes over the same
+    # zlib shard against a job-local daemon + one control without it.
+    # A host without AF_UNIX/shm support skips THIS config, not the
+    # whole report (the rest of the series already ran).
+    try:
+        shared_cache = _shared_cache_bench()
+    except Exception as e:
+        shared_cache = {"skipped": repr(e)}
+
+    # per-config link-probe medians: the global min/median/max collapses
+    # every config's window into one undiagnosable spread number
+    # (BENCH_r05's link_variability 27.9); per-config medians show WHICH
+    # configs ran in degraded link windows
+    link_by_config = {
+        name: round(
+            median([mb for tag, mb in probe.samples if tag == name]), 1
+        )
+        for name, _fn in tasks
+    }
+
     value = med("higgs_f16")
     host_higgs = med("higgs_host")
     rec_med = med("rec_f16")
@@ -950,6 +1091,13 @@ def main() -> None:
                 "recordio_zlib_decoded_mb_per_sec": med(
                     "rec_zlib", "mb_per_sec"
                 ),
+                # host-shared decoded-block cache (ISSUE 7 acceptance):
+                # a SECOND process over the same compressed shard served
+                # from the per-host daemon vs decoding alone
+                "rec_zlib_shared_cache": shared_cache,
+                "shared_cache_speedup": shared_cache.get(
+                    "shared_cache_speedup"
+                ),
                 **_codec_summary(),
                 # gather/legacy speedup is THE tentpole acceptance
                 # number (ISSUE 6: >= 10x): the shuffled record-mode
@@ -999,6 +1147,7 @@ def main() -> None:
                 "link_sustained_mb_per_sec": sustained,
                 "link_probe_mb_per_sec": link,
                 "link_variability": round(link["max"] / link["min"], 2),
+                "link_probe_by_config": link_by_config,
                 "link_probe_series": probe.samples,
                 "stage_secs_rec": stage_secs_rec,
                 "rec_f32_f16_byte_ratio": round(rec_byte_ratio, 4),
@@ -1041,4 +1190,8 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 4 and sys.argv[1] == "--shared-cache-drain":
+        # worker mode: host-side drain only, no jax, no data generation
+        _shared_cache_drain_main(sys.argv[2], sys.argv[3])
+    else:
+        main()

@@ -23,10 +23,17 @@ from .filesystem import (  # noqa: F401
 )
 from . import codec  # noqa: F401 — the single compression site (L009)
 from .codec import (  # noqa: F401
+    DecodeContext,
     DecodedBlockCache,
     available_codecs,
     default_decode_cache,
+    default_decode_context,
     get_codec,
+)
+from . import blockcache  # noqa: F401 — the shm/socket site (L010)
+from .blockcache import (  # noqa: F401
+    BlockCacheClient,
+    BlockCacheDaemon,
 )
 from .recordio import (  # noqa: F401
     KMAGIC,

@@ -243,8 +243,15 @@ class HttpFileSystem(FileSystem):
     def get_path_info(self, uri: str) -> FileInfo:
         resp = _request(uri, "HEAD")
         size = int(resp.headers.get("Content-Length") or 0)
+        # change token for the decoded-block cache identity: the ETag
+        # when the origin sends one, else Last-Modified, else none
+        etag = (
+            resp.headers.get("ETag")
+            or resp.headers.get("Last-Modified")
+            or ""
+        )
         resp.close()
-        return FileInfo(uri, size, "file")
+        return FileInfo(uri, size, "file", etag)
 
     def list_directory(self, uri: str) -> List[FileInfo]:
         raise Error("http(s) filesystem cannot list directories")
@@ -538,8 +545,11 @@ class S3FileSystem(FileSystem):
                     return FileInfo(uri.rstrip("/") + "/", 0, "directory")
             raise
         size = int(resp.headers.get("Content-Length") or 0)
+        # the object's ETag (S3 and the GCS XML API both send one on
+        # HEAD): an in-place rewrite changes it even at identical size
+        etag = resp.headers.get("ETag") or ""
         resp.close()
-        return FileInfo(uri, size, "file")
+        return FileInfo(uri, size, "file", etag)
 
     def delete(self, uri: str, recursive: bool = False) -> None:
         """DELETE object; with ``recursive``, every object under the
@@ -653,15 +663,23 @@ class S3FileSystem(FileSystem):
                 tag = el.tag.rsplit("}", 1)[-1]
                 if tag == "Contents":
                     k = s = None
+                    etag = ""
                     for child in el:
                         ctag = child.tag.rsplit("}", 1)[-1]
                         if ctag == "Key":
                             k = child.text
                         elif ctag == "Size":
                             s = int(child.text or 0)
+                        elif ctag == "ETag":
+                            etag = child.text or ""
                     if k and k != prefix:
                         out.append(
-                            FileInfo(f"{self.protocol}{bucket}/{k}", s or 0, "file")
+                            FileInfo(
+                                f"{self.protocol}{bucket}/{k}",
+                                s or 0,
+                                "file",
+                                etag,
+                            )
                         )
                 elif tag == "CommonPrefixes":
                     for child in el:
@@ -1131,7 +1149,13 @@ class WebHdfsFileSystem(FileSystem):
         body = _read_all(self._url(uri, "GETFILESTATUS"))
         st = json.loads(body)["FileStatus"]
         ftype = "directory" if st["type"] == "DIRECTORY" else "file"
-        return FileInfo(uri, int(st.get("length", 0)), ftype)
+        # HDFS has no ETag; modificationTime (epoch millis) is the
+        # namenode's change token and serves the same cache-identity job
+        mtime = st.get("modificationTime")
+        return FileInfo(
+            uri, int(st.get("length", 0)), ftype,
+            str(mtime) if mtime else "",
+        )
 
     def delete(self, uri: str, recursive: bool = False) -> None:
         url = self._url(
@@ -1151,9 +1175,13 @@ class WebHdfsFileSystem(FileSystem):
         base = uri.rstrip("/")
         for st in statuses:
             ftype = "directory" if st["type"] == "DIRECTORY" else "file"
+            mtime = st.get("modificationTime")
             out.append(
                 FileInfo(
-                    f"{base}/{st['pathSuffix']}", int(st.get("length", 0)), ftype
+                    f"{base}/{st['pathSuffix']}",
+                    int(st.get("length", 0)),
+                    ftype,
+                    str(mtime) if mtime else "",
                 )
             )
         return out
@@ -1212,8 +1240,9 @@ class AzureBlobFileSystem(FileSystem):
     def get_path_info(self, uri: str) -> FileInfo:
         resp = _request(self._url(uri), "HEAD")
         size = int(resp.headers.get("Content-Length") or 0)
+        etag = resp.headers.get("ETag") or ""
         resp.close()
-        return FileInfo(uri, size, "file")
+        return FileInfo(uri, size, "file", etag)
 
     def delete(self, uri: str, recursive: bool = False) -> None:
         if recursive:
@@ -1243,8 +1272,12 @@ class AzureBlobFileSystem(FileSystem):
             for blob in root.iter("Blob"):
                 name = blob.findtext("Name") or ""
                 size = int(blob.findtext("Properties/Content-Length") or 0)
+                etag = blob.findtext("Properties/Etag") or ""
                 out.append(
-                    FileInfo(f"{self.protocol}{container}/{name}", size, "file")
+                    FileInfo(
+                        f"{self.protocol}{container}/{name}", size, "file",
+                        etag,
+                    )
                 )
             marker = root.findtext("NextMarker") or ""
             if not marker:

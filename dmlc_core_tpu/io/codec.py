@@ -42,6 +42,7 @@ is the compression ratio bench.py reports), the
 
 from __future__ import annotations
 
+import hashlib
 import struct
 import threading
 import time as _time
@@ -58,6 +59,7 @@ from ..utils.logging import Error, check
 __all__ = [
     "BLOCK_HEADER",
     "Codec",
+    "DecodeContext",
     "DecodedBlockCache",
     "available_codecs",
     "crc32",
@@ -65,10 +67,12 @@ __all__ = [
     "decode_blocks",
     "decode_threads",
     "default_decode_cache",
+    "default_decode_context",
     "default_decode_pool",
     "encode_block",
     "get_codec",
     "register_codec",
+    "wire_block_key",
 ]
 
 # codec_id, version, reserved, n_records, raw_len, crc32
@@ -494,3 +498,135 @@ def default_decode_cache() -> DecodedBlockCache:
                     get_env("DMLC_DECODE_CACHE_MB", 256) * (1 << 20)
                 )
     return _CACHE
+
+
+# -- the decode seam: two-level cache + pool behind one object ----------------
+def wire_block_key(key: object) -> str:
+    """Flatten a structured block identity to the content-addressed
+    string the host daemon keys on. The identity must be built from
+    plain strings/ints/tuples (the splitter's file-set signature +
+    layout digest + block offset) — ``repr`` of those is deterministic
+    ACROSS processes, which Python's seeded ``hash()`` is not, and
+    cross-process agreement is the whole point of the shared tier."""
+    if isinstance(key, str):
+        return key
+    return hashlib.sha1(repr(key).encode()).hexdigest()
+
+
+class DecodeContext:
+    """The single seam every block-decode consumer rides: in-process
+    LRU (L1), then the host-shared daemon tier (L2, io/blockcache.py),
+    then decode — plus the shared decompress pool. The window loader,
+    ``_decoded_block``, and ``decode_chunk`` all go through one of
+    these instead of reaching into module globals, so tests can inject
+    a fake daemon or a private LRU, and the two-level policy lives in
+    exactly one place.
+
+    ``shared='auto'`` (the default) resolves the process-wide daemon
+    client lazily (one connect attempt, cached negative result —
+    blockcache.default_client); ``shared=None`` pins the context to
+    in-process-only behavior; any client-shaped object (``get``/
+    ``publish``) is used as given.
+    """
+
+    _AUTO = "auto"
+
+    def __init__(
+        self,
+        cache: Optional[DecodedBlockCache] = None,
+        shared: object = "auto",
+    ) -> None:
+        self._cache = cache
+        self._shared = shared
+
+    def cache(self) -> DecodedBlockCache:
+        return self._cache if self._cache is not None else (
+            default_decode_cache()
+        )
+
+    def shared(self):
+        """The L2 client, or None (disabled/absent daemon)."""
+        if self._shared == self._AUTO:
+            from .blockcache import default_client
+
+            return default_client()
+        return self._shared
+
+    def get_block(self, key: object) -> Optional[bytes]:
+        """L1 then L2; an L2 hit is promoted into L1 so repeats inside
+        one process stay memory-local."""
+        data = self.cache().get(key)
+        if data is not None:
+            return data
+        shared = self.shared()
+        if shared is not None:
+            try:
+                data = shared.get(wire_block_key(key))
+            except Exception:  # the shared tier is best-effort, always
+                data = None
+            if data is not None:
+                self.cache().put(key, data)
+        return data
+
+    def get_blocks(self, keys) -> Dict[object, bytes]:
+        """Bulk ``get_block``: L1 each key, then ONE shared-tier round
+        trip for all L1 misses (client.get_many) — the batched path the
+        window loader and range emission ride so per-block IPC can't
+        eat the decode win. Returns only the keys found; callers decode
+        the rest."""
+        cache = self.cache()
+        out: Dict[object, bytes] = {}
+        missing = []
+        for key in keys:
+            data = cache.get(key)
+            if data is not None:
+                out[key] = data
+            else:
+                missing.append(key)
+        if missing:
+            shared = self.shared()
+            if shared is not None:
+                by_wire = {wire_block_key(k): k for k in missing}
+                try:
+                    got = shared.get_many(list(by_wire))
+                except Exception:
+                    got = {}
+                for wire, data in got.items():
+                    key = by_wire[wire]
+                    cache.put(key, data)
+                    out[key] = data
+        return out
+
+    def put_block(self, key: object, raw: bytes) -> None:
+        """Retain decoded bytes in L1 and offer them to the host tier
+        (a lost publish race or absent daemon is a silent no-op)."""
+        self.cache().put(key, raw)
+        shared = self.shared()
+        if shared is not None:
+            try:
+                shared.publish(wire_block_key(key), raw)
+            except Exception:
+                pass
+
+    # pool access rides the context too, so a future per-context pool
+    # (or a test's serial fake) needs no caller changes
+    def decode_block(self, blob) -> Tuple[bytes, int]:
+        return decode_block(blob)
+
+    def decode_blocks(self, blobs: List[bytes]) -> List[Tuple[bytes, int]]:
+        return decode_blocks(blobs)
+
+
+_CTX: Optional[DecodeContext] = None
+_CTX_LOCK = threading.Lock()
+
+
+def default_decode_context() -> DecodeContext:
+    """Process-global two-level decode context (L1 = the default LRU,
+    L2 = the host daemon when reachable)."""
+    global _CTX
+    if _CTX is None:
+        with _CTX_LOCK:
+            if _CTX is None:
+                _CTX = DecodeContext()
+    return _CTX

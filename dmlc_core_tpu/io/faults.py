@@ -320,13 +320,16 @@ class FaultInjectingFileSystem(FileSystem):
         inner_uri, spec, host = self._parse(uri)
         info = self._inner_fs(inner_uri).get_path_info(inner_uri)
         return FileInfo(
-            self._refault(host, info.path, spec), info.size, info.type
+            self._refault(host, info.path, spec), info.size, info.type,
+            info.etag,
         )
 
     def list_directory(self, uri: str) -> List[FileInfo]:
         inner_uri, spec, host = self._parse(uri)
         return [
-            FileInfo(self._refault(host, f.path, spec), f.size, f.type)
+            FileInfo(
+                self._refault(host, f.path, spec), f.size, f.type, f.etag
+            )
             for f in self._inner_fs(inner_uri).list_directory(inner_uri)
         ]
 

@@ -38,11 +38,19 @@ __all__ = [
 
 
 class FileInfo(NamedTuple):
-    """Reference io.h:560-578 (FileInfo: path, size, type)."""
+    """Reference io.h:560-578 (FileInfo: path, size, type).
+
+    ``etag`` extends the reference: the backend's change token when one
+    is cheap to surface (S3/GCS/HTTP ETag, WebHDFS modificationTime) —
+    "" when the backend has none. The decoded-block cache identity
+    folds it in, so an IN-PLACE remote rewrite (same path, same size,
+    same block geometry) can never serve stale decoded bytes from a
+    cache keyed before the rewrite (io/split.py)."""
 
     path: str
     size: int
     type: str  # 'file' | 'directory'
+    etag: str = ""
 
 
 FS_REGISTRY: Registry = Registry("filesystem")

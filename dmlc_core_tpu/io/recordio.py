@@ -596,14 +596,15 @@ def scan_compressed_blob(view: memoryview, pos: int) -> Tuple[bytes, int]:
         first = False
 
 
-def decode_chunk(chunk: bytes) -> bytes:
+def decode_chunk(chunk: bytes, ctx=None) -> bytes:
     """Decode every compressed block in a chunk of whole frames,
     passing v1 frames through untouched; returns pure v1 framed bytes
     (byte-identical to what an uncompressed writer emits for the same
     records). Chunks without compressed frames return unchanged (same
-    object) after one vectorized scan. Blocks decode in parallel on the
-    shared codec pool, so a prefetch thread pulling chunks overlaps
-    network reads with decompression."""
+    object) after one vectorized scan. Blocks decode in parallel
+    through ``ctx`` (a codec.DecodeContext; default the process-global
+    one), so a prefetch thread pulling chunks overlaps network reads
+    with decompression and tests can inject a serial/fake context."""
     if not chunk_has_compressed(chunk):
         return chunk
     view = memoryview(chunk)
@@ -628,7 +629,9 @@ def decode_chunk(chunk: bytes) -> bytes:
     check(pos == n, "RecordIO chunk: trailing partial frame")
     if run_start < n:
         out.append(view[run_start:n])
-    decoded = _codec.decode_blocks(blobs)
+    if ctx is None:
+        ctx = _codec.default_decode_context()
+    decoded = ctx.decode_blocks(blobs)
     return b"".join(
         decoded[p][0] if isinstance(p, int) else p for p in out
     )

@@ -39,7 +39,7 @@ from __future__ import annotations
 import http.client
 import os
 import random
-import socket
+import socket  # noqa: L010 (exception classification only, no sockets made)
 import threading
 import time
 import urllib.error

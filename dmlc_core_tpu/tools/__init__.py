@@ -301,6 +301,71 @@ def _cmd_info(args) -> int:
     return 0
 
 
+def _cmd_cached(args) -> int:
+    """Operator surface for the host-level shared decoded-block cache
+    (io/blockcache.py, docs/recordio.md):
+
+    - ``serve``: run the per-host daemon in the foreground (what
+      ``dmlc-submit --block-cache`` launches once per host) until
+      SIGINT/SIGTERM; owned shared-memory segments are unlinked on the
+      way out.
+    - ``stats``: one JSON snapshot of the daemon's store — entries,
+      resident bytes, hit/miss/publish/eviction counts, per-tenant
+      breakdown.
+    - ``flush``: evict every unleased block (leased segments stay —
+      a mapped view is never unlinked under a reader).
+    """
+    import json
+    import signal
+
+    from ..io import blockcache
+
+    sock = args.socket or blockcache.default_sock_path()
+    if args.action == "serve":
+        daemon = blockcache.BlockCacheDaemon(
+            sock,
+            max_bytes=(args.budget_mb << 20) if args.budget_mb else None,
+            tenant_max_bytes=(
+                (args.tenant_mb << 20) if args.tenant_mb else None
+            ),
+            metrics_port=args.metrics_port,
+        )
+        daemon.start()
+        signal.signal(signal.SIGTERM, lambda *_a: daemon.close())
+        print(
+            f"block-cache daemon pid {daemon.stats()['pid']} serving "
+            f"{sock} (budget {daemon.max_bytes >> 20} MB"
+            + (
+                f", /metrics on 127.0.0.1:{args.metrics_port}"
+                if args.metrics_port
+                else ""
+            )
+            + ")",
+            file=sys.stderr,
+        )
+        try:
+            daemon.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            daemon.close()
+        return 0
+    client = blockcache.BlockCacheClient(sock)
+    if args.action == "stats":
+        stats = client.stats()
+        if stats is None:
+            print(f"error: no block-cache daemon at {sock}", file=sys.stderr)
+            return 1
+        print(json.dumps(stats, indent=2))
+        return 0
+    evicted = client.flush()
+    if evicted is None:
+        print(f"error: no block-cache daemon at {sock}", file=sys.stderr)
+        return 1
+    print(json.dumps({"evicted": evicted}))
+    return 0
+
+
 def _cmd_ckpt(args) -> int:
     """Operator surface for checkpoint directories: list steps with
     layout/size, inspect a step's tree shapes, prune to a retention
@@ -458,6 +523,30 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
     info = sub.add_parser("info", help="runtime feature report (JSON)")
     info.set_defaults(fn=_cmd_info)
+
+    cd = sub.add_parser(
+        "cached", help="host-level shared decoded-block cache daemon"
+    )
+    cd.add_argument("action", choices=["serve", "stats", "flush"])
+    cd.add_argument(
+        "--socket", default="",
+        help="UNIX socket path (default: $DMLC_BLOCK_CACHE_SOCK or the "
+             "uid-scoped temp-dir path)",
+    )
+    cd.add_argument(
+        "--budget-mb", default=0, type=int,
+        help="serve: total resident budget (default "
+             "$DMLC_BLOCK_CACHE_MB or 1024)",
+    )
+    cd.add_argument(
+        "--tenant-mb", default=0, type=int,
+        help="serve: per-tenant byte quota (default the whole budget)",
+    )
+    cd.add_argument(
+        "--metrics-port", default=0, type=int,
+        help="serve: loopback /metrics port (0 = off)",
+    )
+    cd.set_defaults(fn=_cmd_cached)
 
     ck = sub.add_parser(
         "ckpt", help="inspect/prune checkpoint directories (any URI)"

@@ -15,13 +15,57 @@ from __future__ import annotations
 
 import logging
 import os
+import shutil
 import subprocess
-from typing import Dict, List
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional
 
 from ..supervisor import Supervisor, default_max_attempt
 from . import run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
+
+
+class HostBlockCache:
+    """One shared decoded-block cache daemon for this host's tasks
+    (``dmlc-submit --block-cache``): spawns ``tools cached serve`` on a
+    job-private socket, waits for it to answer, and hands the socket
+    path to every worker via ``DMLC_BLOCK_CACHE_SOCK`` — the
+    decode-once-per-host tier of io/blockcache.py. ``stop()`` tears the
+    daemon (and its shared-memory segments) down with the job."""
+
+    def __init__(self, budget_mb: int = 0) -> None:
+        self._sock_dir = tempfile.mkdtemp(prefix="dmlc-blockcache-")
+        self.sock_path = os.path.join(self._sock_dir, "cache.sock")
+        cmd = [
+            sys.executable, "-m", "dmlc_core_tpu.tools", "cached",
+            "serve", "--socket", self.sock_path,
+        ]
+        if budget_mb:
+            cmd += ["--budget-mb", str(budget_mb)]
+        self._proc = subprocess.Popen(cmd)
+        deadline = time.monotonic() + 10.0
+        while not os.path.exists(self.sock_path):
+            if self._proc.poll() is not None or time.monotonic() > deadline:
+                self.stop()
+                raise RuntimeError(
+                    "block-cache daemon failed to start "
+                    f"(socket {self.sock_path} never appeared)"
+                )
+            time.sleep(0.05)
+        logger.info("block-cache daemon serving %s", self.sock_path)
+
+    def stop(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+        shutil.rmtree(self._sock_dir, ignore_errors=True)
 
 
 def make_launcher(
@@ -52,14 +96,23 @@ def make_launcher(
 
 def submit(args) -> None:
     checks: List = []
+    cache: Optional[HostBlockCache] = None
 
     def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
+        nonlocal cache
         if args.dry_run:
+            if getattr(args, "block_cache", False):
+                print("[dry-run] block-cache daemon: "
+                      "python -m dmlc_core_tpu.tools cached serve")
             for i in range(nworker + nserver):
                 role = "worker" if i < nworker else "server"
                 print(f"[dry-run] local task {i} role={role}: "
                       f"{' '.join(args.command)}")
             return
+        if getattr(args, "block_cache", False):
+            cache = HostBlockCache(getattr(args, "block_cache_mb", 0))
+            envs = dict(envs)
+            envs["DMLC_BLOCK_CACHE_SOCK"] = cache.sock_path
         # --local-num-attempt retries == max_attempt total runs - 1
         # (reference local.py retry budget); DMLC_MAX_ATTEMPT wins if set.
         # localhost is one shared host, not a failure domain — per-task
@@ -80,7 +133,11 @@ def submit(args) -> None:
             )
         )
 
-    run_tracker_submit(
-        args, launch_all,
-        abort_check=lambda: checks[0]() if checks else None,
-    )
+    try:
+        run_tracker_submit(
+            args, launch_all,
+            abort_check=lambda: checks[0]() if checks else None,
+        )
+    finally:
+        if cache is not None:
+            cache.stop()

@@ -98,6 +98,20 @@ def get_opts(args: Optional[List[str]] = None):
     parser.add_argument("--kube-worker-image", default="mxnet/python", type=str)
     parser.add_argument("--kube-server-image", default="mxnet/python", type=str)
     parser.add_argument("--local-num-attempt", default=0, type=int)
+    # host-level shared decoded-block cache (io/blockcache.py): start
+    # ONE daemon per host and point every worker at it, so colocated
+    # workers over the same compressed corpus decode each block once
+    parser.add_argument(
+        "--block-cache", action="store_true", default=False,
+        help="Start a per-host shared decoded-block cache daemon and "
+             "export DMLC_BLOCK_CACHE_SOCK to the workers (local "
+             "backend; other backends launch 'tools cached serve' "
+             "per host themselves — docs/recordio.md).",
+    )
+    parser.add_argument(
+        "--block-cache-mb", default=0, type=int,
+        help="Daemon budget in MB (default $DMLC_BLOCK_CACHE_MB or 1024).",
+    )
     # tpu-pod backend (TPU-native, no reference analogue)
     parser.add_argument(
         "--tpu-name", default=None, type=str,

@@ -69,6 +69,57 @@ def pytest_configure(config):
         "jax: imports jax in-process (excluded from sanitizer runs — the "
         "ASan/TSan runtime trips on XLA internals, not on our native core)",
     )
+    config.addinivalue_line(
+        "markers",
+        "blockcache: needs POSIX shared memory AND UNIX-domain sockets "
+        "(the host-shared decoded-block cache daemon, io/blockcache.py); "
+        "skipped with a visible reason where either is unavailable",
+    )
+
+
+def _blockcache_unsupported():
+    """Reason string when this host cannot run the shared block-cache
+    daemon (no /dev/shm-backed POSIX shm, or no UNIX sockets — e.g.
+    some containers and non-POSIX platforms); None when it can."""
+    import socket
+    import tempfile
+
+    try:
+        from dmlc_core_tpu.io.blockcache import _ShmSegment
+
+        seg = _ShmSegment(f"dmlcprobe-{os.getpid()}", create=True, size=8)
+        try:
+            seg.buf[:2] = b"ok"
+        finally:
+            seg.close()
+            seg.unlink()
+    except Exception as e:
+        return f"POSIX shared memory unavailable: {e!r}"
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                s.bind(os.path.join(d, "probe.sock"))
+            finally:
+                s.close()
+    except Exception as e:
+        return f"UNIX-domain sockets unavailable: {e!r}"
+    return None
+
+
+def pytest_collection_modifyitems(config, items):
+    reason = False  # tri-state: False = not probed yet
+    for item in items:
+        if item.get_closest_marker("blockcache") is None:
+            continue
+        if reason is False:
+            reason = _blockcache_unsupported()
+        if reason:
+            import pytest
+
+            item.add_marker(pytest.mark.skip(
+                reason=f"shared block-cache daemon unsupported: {reason}"
+            ))
 
 # The axon TPU plugin in this image force-registers itself and wins over
 # JAX_PLATFORMS env alone; the config update below reliably pins the test

@@ -1,0 +1,389 @@
+"""Host-level shared decoded-block cache (io/blockcache.py): protocol
+round trips, publish races, lease-gated eviction, per-tenant quotas,
+daemon-death fallback, stale-key safety, and the two-process
+decode-once-per-host acceptance path.
+
+Every test here runs the daemon in-process on a private socket — the
+control plane is a real UNIX socket and the data plane real shared
+memory either way, so cross-process behavior is exercised by the
+subprocess tests at the bottom. The module is gated by the conftest
+``blockcache`` capability probe (skips with a visible reason where
+/dev/shm or UNIX sockets are unavailable)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dmlc_core_tpu.io import blockcache
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.io.blockcache import BlockCacheClient, BlockCacheDaemon
+from dmlc_core_tpu.io.codec import (
+    DecodeContext,
+    DecodedBlockCache,
+    wire_block_key,
+)
+from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+from dmlc_core_tpu.io.stream import FileStream
+
+pytestmark = pytest.mark.blockcache
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = BlockCacheDaemon(
+        str(tmp_path / "cache.sock"), max_bytes=16 << 20
+    ).start()
+    yield d
+    d.close()
+
+
+def client(d, tenant="t"):
+    return BlockCacheClient(d.sock_path, tenant=tenant)
+
+
+# -- protocol basics ----------------------------------------------------------
+def test_publish_then_get_roundtrip(daemon):
+    a, b = client(daemon, "a"), client(daemon, "b")
+    assert a.ping()
+    assert a.get("k") is None
+    assert a.publish("k", b"payload-bytes")
+    assert b.get("k") == b"payload-bytes"
+    st = daemon.stats()
+    assert st["entries"] == 1 and st["publishes"] == 1
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert st["tenants"]["a"]["bytes"] == len(b"payload-bytes")
+
+
+def _wait_leases(daemon, want, tries=100):
+    # lease releases are oneway frames — give the daemon a beat
+    while daemon.stats()["active_leases"] != want and tries:
+        tries -= 1
+        threading.Event().wait(0.01)
+    return daemon.stats()["active_leases"]
+
+
+def test_get_view_is_shared_memory(daemon):
+    a = client(daemon)
+    a.publish("k", b"0123456789")
+    v = a.get_view("k")
+    assert bytes(v.view) == b"0123456789" and len(v) == 10
+    assert daemon.stats()["active_leases"] == 1
+    v.close()
+    assert _wait_leases(daemon, 0) == 0
+
+
+def test_publish_race_single_winner(daemon):
+    """Two processes decode the same block and publish concurrently:
+    exactly one copy is adopted, the loser unlinks its segment and its
+    next lookup hits the winner's bytes."""
+    a, b = client(daemon, "a"), client(daemon, "b")
+    data = b"x" * 4096
+    assert a.publish("blk", data)
+    assert not b.publish("blk", data)  # duplicate -> loser
+    assert b.get("blk") == data  # ...and the loser now hits
+    st = daemon.stats()
+    assert st["entries"] == 1 and st["bytes"] == len(data)
+
+    # a genuinely concurrent race from two connections stays clean:
+    # every key ends with exactly one resident copy
+    daemon_bytes = st["bytes"]
+    wins = []
+
+    def racer(c):
+        got = [c.publish(f"race-{i}", bytes([i]) * 512) for i in range(8)]
+        wins.append(got)
+
+    t1 = threading.Thread(target=racer, args=(client(daemon, "r1"),))
+    t2 = threading.Thread(target=racer, args=(client(daemon, "r2"),))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    st = daemon.stats()
+    assert st["entries"] == 9  # blk + 8 race keys, each exactly once
+    assert st["bytes"] == daemon_bytes + 8 * 512
+    for i, (w1, w2) in enumerate(zip(*wins)):
+        assert w1 != w2 or not (w1 and w2), f"race-{i} adopted twice"
+        assert client(daemon).get(f"race-{i}") == bytes([i]) * 512
+
+
+def test_eviction_never_unlinks_leased(tmp_path):
+    """A reader holding a leased view keeps its segment alive through
+    arbitrary eviction pressure; the lease's release makes it evictable
+    again."""
+    d = BlockCacheDaemon(str(tmp_path / "c.sock"), max_bytes=25).start()
+    try:
+        a = client(d)
+        assert a.publish("x1", b"0123456789")
+        v = a.get_view("x1")  # lease held across the pressure below
+        assert a.publish("x2", b"0123456789")
+        assert a.publish("x3", b"0123456789")  # over budget: must evict
+        st = d.stats()
+        assert st["evictions"] == 1
+        assert a.get("x2") is None  # the LRU *unleased* entry went
+        assert a.get("x1") == b"0123456789"  # leased entry survived
+        assert bytes(v.view) == b"0123456789"  # mapping still valid
+        v.close()
+        assert a.publish("x4", b"0123456789")  # x1 now evictable
+        st = d.stats()
+        assert st["bytes"] <= 25
+    finally:
+        d.close()
+
+
+def test_oversized_and_tenant_quota_rejected(tmp_path):
+    d = BlockCacheDaemon(
+        str(tmp_path / "c.sock"), max_bytes=1 << 20, tenant_max_bytes=64
+    ).start()
+    try:
+        a, b = client(d, "a"), client(d, "b")
+        assert not a.publish("big", b"z" * 128)  # > tenant quota: rejected
+        assert a.publish("a1", b"z" * 48)
+        assert a.publish("a2", b"z" * 48)  # evicts a1 WITHIN tenant a
+        assert b.publish("b1", b"y" * 48)  # b's quota untouched by a
+        st = d.stats()
+        assert st["rejected"] == 1
+        assert st["tenants"]["a"]["bytes"] == 48
+        assert st["tenants"]["b"]["bytes"] == 48
+        assert a.get("a1") is None and a.get("a2") is not None
+    finally:
+        d.close()
+
+
+def test_connection_drop_releases_leases(daemon):
+    a = client(daemon)
+    a.publish("k", b"data-here")
+    v = a.get_view("k")
+    assert daemon.stats()["active_leases"] == 1
+    a.close()  # connection gone WITHOUT releasing
+    deadline = 50
+    while daemon.stats()["active_leases"] and deadline:
+        deadline -= 1
+        threading.Event().wait(0.02)
+    assert daemon.stats()["active_leases"] == 0
+    del v
+
+
+def test_flush_keeps_leased(daemon):
+    a = client(daemon)
+    a.publish("k1", b"one")
+    a.publish("k2", b"two")
+    v = a.get_view("k1")
+    assert a.flush() == 1  # k2 only; k1 is leased
+    assert a.get("k1") == b"one"
+    v.close()
+    assert a.flush() == 1
+
+
+# -- client fallback behavior -------------------------------------------------
+def test_default_client_negative_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "DMLC_BLOCK_CACHE_SOCK", str(tmp_path / "nothing-here.sock")
+    )
+    blockcache.reset_default_client()
+    try:
+        assert blockcache.default_client() is None
+        assert blockcache.default_client() is None  # cached, no re-probe
+    finally:
+        blockcache.reset_default_client()
+
+
+def test_env_off_disables_even_with_live_daemon(daemon, monkeypatch):
+    monkeypatch.setenv("DMLC_BLOCK_CACHE_SOCK", daemon.sock_path)
+    monkeypatch.setenv("DMLC_BLOCK_CACHE", "off")
+    blockcache.reset_default_client()
+    try:
+        assert blockcache.default_client() is None
+    finally:
+        blockcache.reset_default_client()
+
+
+# -- splitter integration -----------------------------------------------------
+def _write_zlib_rec(tmp_path, n=1200, rewrite_tag=b""):
+    rec = str(tmp_path / "data.rec")
+    idx = rec + ".idx"
+    with FileStream(rec, "w") as f, FileStream(idx, "w") as fi:
+        w = IndexedRecordIOWriter(f, fi, codec="zlib", block_bytes=1 << 12)
+        for i in range(n):
+            w.write_record((rewrite_tag or b"A") + (b"%06d" % i) * 18)
+        w.flush_block()
+    return rec, idx
+
+
+def _drain(rec, idx, ctx, kill_daemon_after=None):
+    sp = io_split.IndexedRecordIOSplitter(
+        rec, idx, 0, 1, shuffle="window", window=200, seed=5,
+        decode_ctx=ctx,
+        # no readahead when a mid-read daemon kill is staged: window
+        # loads must interleave with the consumer's pulls so some
+        # happen strictly AFTER the kill
+        readahead=kill_daemon_after is None,
+    )
+    out = []
+    pulls = 0
+    while True:
+        chunk = sp.next_batch_ex(256)
+        if chunk is None:
+            break
+        out.append(chunk)
+        pulls += 1
+        if kill_daemon_after is not None and pulls == 2:
+            kill_daemon_after.close()
+    stats = sp.io_stats()
+    sp.close()
+    return b"".join(out), stats
+
+
+def test_second_context_decodes_nothing(daemon, tmp_path):
+    """The acceptance shape in-process: reader 2 (fresh L1, same
+    daemon) serves every block from the shared tier — its own decode
+    count stays flat and the bytes are identical."""
+    from dmlc_core_tpu.telemetry import default_registry
+
+    rec, idx = _write_zlib_rec(tmp_path)
+    c1, c2 = client(daemon, "p1"), client(daemon, "p2")
+    b1, st1 = _drain(rec, idx, DecodeContext(
+        cache=DecodedBlockCache(1 << 24), shared=c1))
+    assert st1["decode_cache_misses"] > 0 and c1.publishes > 0
+
+    hist = default_registry().histogram("io.codec.decode_seconds")
+    decodes_before = hist.snapshot()["count"]
+    b2, st2 = _drain(rec, idx, DecodeContext(
+        cache=DecodedBlockCache(1 << 24), shared=c2))
+    assert b2 == b1
+    assert hist.snapshot()["count"] == decodes_before  # zero decodes
+    assert c2.hits > 0 and c2.misses == 0
+    assert st2["decode_cache_hits"] > 0 and st2["decode_cache_misses"] == 0
+
+
+def test_daemon_killed_mid_read_degrades_silently(tmp_path):
+    """Killing the daemon between windows costs only the shared tier:
+    the iterator finishes byte-identical via in-process decode, no
+    error surfaces."""
+    rec, idx = _write_zlib_rec(tmp_path)
+    clean, _ = _drain(rec, idx, DecodeContext(
+        cache=DecodedBlockCache(1 << 24), shared=None))
+    d = BlockCacheDaemon(str(tmp_path / "kill.sock"), max_bytes=16 << 20)
+    d.start()
+    c = client(d)
+    got, _ = _drain(
+        rec, idx,
+        # zero-budget L1: EVERY window consults the shared tier, so
+        # some lookups land strictly after the kill
+        DecodeContext(cache=DecodedBlockCache(0), shared=c),
+        kill_daemon_after=d,
+    )
+    assert got == clean
+    assert not c.alive  # marked dead, later calls are cheap no-ops
+
+
+def test_stale_mtime_misses_not_serves(daemon, tmp_path):
+    """An in-place rewrite (same path, same size, same block geometry)
+    changes the cache identity: the second reader MISSES the daemon and
+    decodes the new bytes instead of being served the old ones."""
+    rec, idx = _write_zlib_rec(tmp_path, rewrite_tag=b"A")
+    c1 = client(daemon, "p1")
+    b1, _ = _drain(rec, idx, DecodeContext(
+        cache=DecodedBlockCache(1 << 24), shared=c1))
+
+    rec2, idx2 = _write_zlib_rec(tmp_path, rewrite_tag=b"B")
+    assert rec2 == rec and os.path.getsize(rec) == os.path.getsize(rec2)
+    os.utime(rec, ns=(1, 1))  # force a distinct mtime_ns either way
+
+    c2 = client(daemon, "p2")
+    b2, _ = _drain(rec, idx, DecodeContext(
+        cache=DecodedBlockCache(1 << 24), shared=c2))
+    assert b2 != b1  # new content came through
+    assert c2.hits == 0 and c2.misses > 0  # old identity never matched
+
+
+def test_wire_key_stable_across_processes(tmp_path):
+    """The daemon key must be identical from two distinct interpreters
+    (Python's hash() is seed-randomized; the sha1 identity is not)."""
+    key = (("file.rec", 123, 456, "etag-x"), 789, "aa" * 20)
+    script = (
+        "from dmlc_core_tpu.io.codec import wire_block_key;"
+        f"print(wire_block_key({key!r}))"
+    )
+    outs = {
+        subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, check=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip()
+        for _ in range(2)
+    }
+    assert len(outs) == 1 and outs.pop() == wire_block_key(key)
+
+
+_DRAIN_SCRIPT = """
+import json, sys
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.telemetry import default_registry
+rec, idx = sys.argv[1], sys.argv[2]
+sp = io_split.IndexedRecordIOSplitter(rec, idx, 0, 1, shuffle="window",
+                                      window=200, seed=5)
+n = 0
+while True:
+    c = sp.next_batch_ex(256)
+    if c is None:
+        break
+    n += len(c)
+sp.close()
+reg = default_registry()
+hits = sum(v for k, v in reg.counter_values("io.blockcache.hits").items())
+print(json.dumps({
+    "bytes": n,
+    "decodes": reg.histogram("io.codec.decode_seconds").snapshot()["count"],
+    "blockcache_hits": hits,
+}))
+"""
+
+
+def test_two_real_processes_decode_once_per_host(daemon, tmp_path):
+    """The acceptance criterion proper: a SECOND process over the same
+    compressed shard shows io.blockcache.hits > 0 and decodes zero
+    blocks itself, through the default (env-resolved) client path."""
+    rec, idx = _write_zlib_rec(tmp_path)
+    env = dict(os.environ, DMLC_BLOCK_CACHE_SOCK=daemon.sock_path,
+               JAX_PLATFORMS="cpu")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def run():
+        out = subprocess.run(
+            [sys.executable, "-c", _DRAIN_SCRIPT, rec, idx],
+            capture_output=True, text=True, env=env, cwd=repo, check=True,
+        )
+        return json.loads(out.stdout)
+
+    first = run()
+    assert first["decodes"] > 0 and first["blockcache_hits"] == 0
+    second = run()
+    assert second["bytes"] == first["bytes"]
+    assert second["blockcache_hits"] > 0
+    assert second["decodes"] == 0  # decode-once-per-host
+    assert daemon.stats()["publishes"] > 0
+
+
+# -- CLI ----------------------------------------------------------------------
+def test_tools_cached_stats_and_flush(daemon, capsys):
+    from dmlc_core_tpu.tools import main as tools_main
+
+    client(daemon).publish("k", b"some-bytes")
+    assert tools_main(["cached", "stats", "--socket", daemon.sock_path]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["entries"] == 1 and stats["publishes"] == 1
+    assert tools_main(["cached", "flush", "--socket", daemon.sock_path]) == 0
+    assert json.loads(capsys.readouterr().out) == {"evicted": 1}
+    assert daemon.stats()["entries"] == 0
+
+
+def test_tools_cached_no_daemon(tmp_path, capsys):
+    from dmlc_core_tpu.tools import main as tools_main
+
+    rc = tools_main(
+        ["cached", "stats", "--socket", str(tmp_path / "absent.sock")]
+    )
+    assert rc == 1
+    assert "no block-cache daemon" in capsys.readouterr().err

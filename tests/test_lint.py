@@ -225,6 +225,57 @@ def test_codec_import_quiet_in_codec_layer(tmp_path):
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
 
 
+def test_shm_socket_import_flagged_in_io(tmp_path):
+    """L010: shared memory + raw sockets inside dmlc_core_tpu/io/ are
+    one layer (io/blockcache.py), mirroring L006/L008/L009."""
+    assert [c for c, _ in _lib_findings(
+        "import socket\nsocket.socket()\n", tmp_path)] == ["L010"]
+    assert [c for c, _ in _lib_findings(
+        "from socket import socket\nsocket()\n", tmp_path)] == ["L010"]
+    assert [c for c, _ in _lib_findings(
+        "import multiprocessing.shared_memory as sm\nsm.SharedMemory\n",
+        tmp_path)] == ["L010"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing import shared_memory\n"
+        "shared_memory.SharedMemory\n", tmp_path)] == ["L010"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing.shared_memory import SharedMemory\n"
+        "SharedMemory\n", tmp_path)] == ["L010"]
+    # the low-level primitive blockcache actually rides is banned too
+    assert [c for c, _ in _lib_findings(
+        "import _posixshmem\n_posixshmem.shm_open\n", tmp_path)
+    ] == ["L010"]
+
+
+def test_shm_socket_quiet_outside_io_and_in_blockcache(tmp_path):
+    # the rule is scoped to dmlc_core_tpu/io/ — the tracker's sockets
+    # (rendezvous protocol) are its own business
+    assert codes("import socket\nsocket.socket()\n", tmp_path) == []
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True)
+    f = d / "protocol.py"
+    f.write_text("import socket\nsocket.socket()\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # io/blockcache.py owns the single site and is exempt
+    d = tmp_path / "dmlc_core_tpu" / "io"
+    d.mkdir(parents=True)
+    f = d / "blockcache.py"
+    f.write_text(
+        "import socket\nfrom multiprocessing import shared_memory\n"
+        "socket.socket(); shared_memory.SharedMemory\n"
+    )
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # plain multiprocessing (pools, queues) is NOT the rule's business
+    assert _lib_findings(
+        "import multiprocessing\nmultiprocessing.cpu_count()\n", tmp_path
+    ) == []
+    # per-line opt-out (io/retry.py's exception classification)
+    assert _lib_findings(
+        "import socket  # noqa: L010 (exception classification)\n"
+        "socket.timeout\n", tmp_path
+    ) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -37,6 +37,18 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  block cache — and is exempt; everything
                                  else compresses through it so telemetry
                                  and import guards can't be bypassed)
+  L010 shared-memory / raw socket import in dmlc_core_tpu/io/ (the
+                                 host-level shared block-cache service
+                                 owns the one shm+socket site:
+                                 io/blockcache.py — control-plane
+                                 framing, segment lifecycle, lease
+                                 bookkeeping — and is exempt; everything
+                                 else in io/ rides its client so the
+                                 fallback semantics and io.blockcache.*
+                                 telemetry can't be bypassed. Genuine
+                                 non-cache uses — retry.py's socket
+                                 exception classification — opt out per
+                                 line with `# noqa: L010`.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -291,6 +303,10 @@ def _check_codec_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
 _L006_EXEMPT = ("/io/retry.py",)
 # files allowed to import compression modules directly: the codec layer
 _L009_EXEMPT = ("/io/codec.py",)
+# L010 is SCOPED to dmlc_core_tpu/io/ and exempts the block-cache
+# service, which owns the single shm+socket site
+_L010_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
+_L010_EXEMPT = ("/io/blockcache.py",)
 # trees allowed to call jax.device_put directly: the staging layer owns
 # the transfer call sites; tests build device-resident fixtures.
 # Anchored against the REPO-RELATIVE path (a checkout living under e.g.
@@ -303,6 +319,45 @@ _L007_EXEMPT_DIRS = ("dmlc_core_tpu/staging/", "tests/")
 # and scripts outside the library may legitimately want wall-clock
 _L008_SCOPE_DIRS = ("dmlc_core_tpu/",)
 
+def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any import binding the ``socket`` module or
+    ``multiprocessing.shared_memory`` (incl. ``from multiprocessing
+    import shared_memory`` and ``from multiprocessing.shared_memory
+    import SharedMemory``): inside dmlc_core_tpu/io/ the shared
+    block-cache service is one layer (io/blockcache.py — UNIX-socket
+    control plane, shm segment lifecycle, leases, telemetry), mirroring
+    the L006/L008/L009 single-site pattern. Scoped in lint_file."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.partition(".")[0]
+                if root in ("socket", "_posixshmem"):
+                    yield node.lineno, (
+                        "direct socket/_posixshmem import (cross-process "
+                        "cache traffic belongs to io/blockcache.py)"
+                    )
+                elif alias.name.startswith("multiprocessing.shared_memory"):
+                    yield node.lineno, (
+                        "direct shared_memory import (shared segments "
+                        "belong to io/blockcache.py)"
+                    )
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mod = node.module or ""
+            if mod.partition(".")[0] in ("socket", "_posixshmem"):
+                yield node.lineno, (
+                    "direct socket/_posixshmem import (cross-process "
+                    "cache traffic belongs to io/blockcache.py)"
+                )
+            elif mod.startswith("multiprocessing.shared_memory") or (
+                mod == "multiprocessing"
+                and any(a.name == "shared_memory" for a in node.names)
+            ):
+                yield node.lineno, (
+                    "direct shared_memory import (shared segments "
+                    "belong to io/blockcache.py)"
+                )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -313,6 +368,7 @@ CHECKS = [
     ("L007", _check_direct_device_put),
     ("L008", _check_wall_clock_time),
     ("L009", _check_codec_imports),
+    ("L010", _check_shm_socket_imports),
 ]
 
 
@@ -351,6 +407,15 @@ def lint_file(path: Path) -> List[Finding]:
             else any("/" + d in posix for d in _L008_SCOPE_DIRS)
         ):
             continue
+        if code == "L010":
+            if posix.endswith(_L010_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L010_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L010_SCOPE_DIRS)
+            ):
+                continue
         for line, msg in fn(tree):
             if line not in noqa_lines:
                 out.append((rel, line, code, msg))
