@@ -162,6 +162,15 @@ def ring_put_epoch(workers: int = 3):
 
 
 def main():
+    trace_path = None
+    if "--trace" in sys.argv:  # dump the flight recorder on exit
+        i = sys.argv.index("--trace") + 1
+        # bare --trace (path forgotten) falls back to the default path
+        trace_path = (
+            sys.argv[i]
+            if i < len(sys.argv) and not sys.argv[i].startswith("--")
+            else ""
+        )
     bench.ensure_native()
     bench.ensure_rec_data()
     import jax
@@ -185,6 +194,15 @@ def main():
     from dmlc_core_tpu.telemetry import to_json as telemetry_snapshot
 
     print("telemetry: " + json.dumps(telemetry_snapshot(), default=float))
+    if trace_path is not None:
+        from dmlc_core_tpu.telemetry import tracing
+
+        path = tracing.dump(trace_path or None)
+        print(
+            f"trace: {path} — the A-F epochs above as a Perfetto "
+            "timeline (https://ui.perfetto.dev; stall attribution: "
+            f"python -m dmlc_core_tpu.tools trace report {path})"
+        )
 
 
 if __name__ == "__main__":

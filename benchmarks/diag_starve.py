@@ -132,13 +132,41 @@ def _print_telemetry() -> None:
     print("telemetry: " + json.dumps(to_json()))
 
 
+def _trace_arg():
+    """``--trace <path>``: dump the flight recorder on exit (ISSUE 8)
+    so the per-mode numbers above come WITH their timeline. A bare
+    ``--trace`` (path forgotten, or followed by another flag) dumps to
+    the recorder's default path instead of crashing."""
+    if "--trace" not in sys.argv:
+        return None
+    i = sys.argv.index("--trace") + 1
+    if i < len(sys.argv) and not sys.argv[i].startswith("--"):
+        return sys.argv[i]
+    return ""  # default path (tracing.default_trace_path)
+
+
+def _dump_trace(path) -> None:
+    if path is None:
+        return
+    from dmlc_core_tpu.telemetry import tracing
+
+    out = tracing.dump(path or None)
+    print(
+        f"trace: {out} — the drains above as a Perfetto timeline "
+        "(https://ui.perfetto.dev; stall attribution: "
+        f"python -m dmlc_core_tpu.tools trace report {out})"
+    )
+
+
 def main():
+    trace_path = _trace_arg()
     if "--shuffle" in sys.argv:
         fault = ""
         if "--fault" in sys.argv:  # e.g. --fault resets=2,errors=1,seed=7
             fault = sys.argv[sys.argv.index("--fault") + 1]
         print(json.dumps(shuffle_read_modes(fault), indent=1))
         _print_telemetry()
+        _dump_trace(trace_path)
         return
     import jax
 
@@ -161,6 +189,7 @@ def main():
         out[f"pyspin20ms_{r}"] = put_loop(bufs, N, lambda: spin(0.020))
     print(json.dumps(out, indent=1))
     _print_telemetry()
+    _dump_trace(trace_path)
 
 
 if __name__ == "__main__":

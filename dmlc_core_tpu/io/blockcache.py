@@ -97,6 +97,7 @@ except ImportError:  # pragma: no cover - non-POSIX platform
     _posixshmem = None
 
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from ..utils.env import get_env
 from ..utils.logging import Error, check
 
@@ -388,9 +389,15 @@ class BlockCacheDaemon:
         for key, e in self._store.items():
             if e.leases == 0 and (tenant is None or e.tenant == tenant):
                 t = e.tenant
+                size = e.size
                 self._drop(key, unlink=True)
                 self.evictions += 1
                 _tick("evictions", t)
+                # instants, not spans: an eviction is a moment on the
+                # daemon timeline, and WHEN they cluster is the story
+                _tracing.instant(
+                    "dmlc:blockcache_evict", tenant=t, bytes=size
+                )
                 return True
         return False
 
@@ -484,6 +491,13 @@ class BlockCacheDaemon:
         }
 
     def _handle(self, req: dict, held: set) -> Optional[dict]:
+        # per-op span on the daemon's connection thread: the merged
+        # timeline shows lookup/publish/flush service time next to the
+        # client windows waiting on them (op names are a bounded set)
+        with _tracing.span(f"dmlc:blockcache_{req.get('op')}"):
+            return self._handle_inner(req, held)
+
+    def _handle_inner(self, req: dict, held: set) -> Optional[dict]:
         op = req.get("op")
         tenant = str(req.get("tenant") or "default")
         if op == "ping":

@@ -52,6 +52,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from ..utils.cpus import available_cpus
 from ..utils.env import get_env
 from ..utils.logging import Error, check
@@ -367,8 +368,14 @@ def decode_block(blob) -> Tuple[bytes, int]:
         f"(this reader supports {BLOCK_VERSION})",
     )
     codec = get_codec(codec_id)
+    # flight-recorder span per decode-pool job: the Perfetto timeline
+    # shows each codec-decode worker's occupancy next to the window
+    # loader waiting on it (the registry histogram keeps the aggregate)
     t0 = _time.perf_counter()
-    raw = codec.decompress(blob[BLOCK_HEADER.size:], raw_len)
+    with _tracing.span(
+        "dmlc:decode_block", codec=codec.name, raw_len=raw_len
+    ):
+        raw = codec.decompress(blob[BLOCK_HEADER.size:], raw_len)
     _DECODE_SECONDS.observe(_time.perf_counter() - t0)
     check(
         len(raw) == raw_len,

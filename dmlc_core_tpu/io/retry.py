@@ -47,6 +47,7 @@ import urllib.request
 from typing import Callable, Dict, Optional
 
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from ..utils.logging import Error
 from .stream import SeekStream
 
@@ -269,7 +270,15 @@ class RetryPolicy:
         self.retries += 1
         self.backoff_secs += delay
         _count_retry(delay)
-        self._sleep(delay)
+        # the backoff sleep is a STALL on the trace timeline: a window
+        # load gated on remote IO healing shows up here, attributable
+        # next to the host_pull gap it causes downstream
+        with _tracing.span(
+            "dmlc:retry_backoff",
+            what=what or None,
+            delay_ms=round(delay * 1000.0, 3),
+        ):
+            self._sleep(delay)
 
     def run(self, fn: Callable[[], "object"], what: str = ""):
         """Call ``fn`` with transient-failure retry: non-transient errors

@@ -32,7 +32,9 @@ import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from ..utils.logging import Error, check, check_eq
+from ..utils.profiler import annotate
 from . import codec as _codec
 from . import retry as _retry
 from . import serializer
@@ -1413,41 +1415,49 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 decoded[b] = data
         self.decode_cache_misses += len(missing)
         if missing:
-            if self._span_reader is None:
-                self._span_reader = _SpanReader(
-                    self.files, self.file_offset, self.filesys
-                )
-            marr = np.asarray(missing, dtype=np.int64)
-            offs = self._block_offs[marr]
-            sizes = self._block_sizes[marr]
-            order, starts, ends = _plan_span_bounds(
-                offs, sizes, self.merge_gap
-            )
-            span_begin = offs[order][starts]
-            run_end = np.maximum.accumulate(offs[order] + sizes[order])
-            span_len = run_end[ends - 1] - span_begin
-            blobs: List[bytes] = []
-            blob_bid: List[int] = []
-            for si, (begin, nbytes) in enumerate(
-                zip(span_begin.tolist(), span_len.tolist())
+            # timeline span with the miss count: a window served from
+            # the caches skips this entirely, so the Perfetto row shows
+            # exactly which windows paid a read+decode and how long
+            with _tracing.span(
+                "dmlc:window_span_decode", blocks=len(missing)
             ):
-                data = self._span_reader.read(begin, nbytes)
-                check_eq(len(data), nbytes, "span read truncated")
-                self.spans_read += 1
-                self.bytes_read += nbytes
-                _SPANS.inc()
-                _BYTES_READ.inc(nbytes)
-                mv = memoryview(data)
-                for k in order[starts[si] : ends[si]].tolist():
-                    rel = int(offs[k]) - begin
-                    blob, _end = scan_compressed_blob(
-                        mv[rel : rel + int(sizes[k])], 0
+                if self._span_reader is None:
+                    self._span_reader = _SpanReader(
+                        self.files, self.file_offset, self.filesys
                     )
-                    blobs.append(blob)
-                    blob_bid.append(int(marr[k]))
-            for b, (raw, _n) in zip(blob_bid, ctx.decode_blocks(blobs)):
-                decoded[b] = raw
-                ctx.put_block(self._block_key(b), raw)
+                marr = np.asarray(missing, dtype=np.int64)
+                offs = self._block_offs[marr]
+                sizes = self._block_sizes[marr]
+                order, starts, ends = _plan_span_bounds(
+                    offs, sizes, self.merge_gap
+                )
+                span_begin = offs[order][starts]
+                run_end = np.maximum.accumulate(offs[order] + sizes[order])
+                span_len = run_end[ends - 1] - span_begin
+                blobs: List[bytes] = []
+                blob_bid: List[int] = []
+                for si, (begin, nbytes) in enumerate(
+                    zip(span_begin.tolist(), span_len.tolist())
+                ):
+                    data = self._span_reader.read(begin, nbytes)
+                    check_eq(len(data), nbytes, "span read truncated")
+                    self.spans_read += 1
+                    self.bytes_read += nbytes
+                    _SPANS.inc()
+                    _BYTES_READ.inc(nbytes)
+                    mv = memoryview(data)
+                    for k in order[starts[si] : ends[si]].tolist():
+                        rel = int(offs[k]) - begin
+                        blob, _end = scan_compressed_blob(
+                            mv[rel : rel + int(sizes[k])], 0
+                        )
+                        blobs.append(blob)
+                        blob_bid.append(int(marr[k]))
+                for b, (raw, _n) in zip(
+                    blob_bid, ctx.decode_blocks(blobs)
+                ):
+                    decoded[b] = raw
+                    ctx.put_block(self._block_key(b), raw)
         lens = np.asarray(
             [len(decoded[b]) for b in uniq.tolist()], dtype=np.int64
         )
@@ -1535,6 +1545,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         ``merge_gap`` over a sparse window), the buffer is compacted to
         the records' own bytes with one extra gather, bounding resident
         memory at ~the window's record bytes."""
+        with annotate("dmlc:window_load"):
+            return self._load_window_inner(lo, hi)
+
+    def _load_window_inner(
+        self, lo: int, hi: int
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         perm = np.asarray(self._permutation[lo:hi], dtype=np.int64)
         if self._compressed:
             return self._load_window_compressed(perm)
@@ -1646,7 +1662,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         while got < n:
             buf_state = self._win_buf
             if buf_state is None or self._win_pos >= len(buf_state[1]):
-                if not self._refill_window():
+                with annotate("dmlc:gather_refill"):
+                    refilled = self._refill_window()
+                if not refilled:
                     break
                 buf_state = self._win_buf
             buf, rel, size = buf_state  # type: ignore[misc]
@@ -1687,8 +1705,14 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         check(self.windowed, "next_gather_batch needs a windowed shuffle")
         buf_state = self._win_buf
         if buf_state is None or self._win_pos >= len(buf_state[1]):
-            if not self._refill_window():
-                return None
+            # the refill is the part worth a timeline span: it blocks on
+            # the readahead thread (or loads inline) — a long one IS the
+            # window pipeline starving the consumer. The in-window slice
+            # below is a couple of numpy views; tracing it per batch
+            # would cost more than it shows.
+            with annotate("dmlc:gather_refill"):
+                if not self._refill_window():
+                    return None
             buf_state = self._win_buf
         buf, rel, size = buf_state  # type: ignore[misc]
         take = min(n_records, len(rel) - self._win_pos)

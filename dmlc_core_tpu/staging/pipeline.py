@@ -765,10 +765,11 @@ class StagingPipeline:
         self._slot_i = (self._slot_i + 1) % len(self._slots)
         if slot.pending is not None:
             t0 = get_time()
-            try:
-                self._jax.block_until_ready(slot.pending.result())
-            except (Exception, CancelledError):
-                pass  # the consumer re-raises from its own future
+            with annotate("dmlc:dispatch_slot_wait"):
+                try:
+                    self._jax.block_until_ready(slot.pending.result())
+                except (Exception, CancelledError):
+                    pass  # the consumer re-raises from its own future
             slot.pending = None
             self._observe("dispatch_slot_wait", get_time() - t0)
         return slot

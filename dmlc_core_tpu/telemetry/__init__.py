@@ -13,6 +13,10 @@ Three layers (docs/observability.md):
 - **aggregate** — tracker-side per-rank/cluster merge of worker
   heartbeat snapshots, served over a local HTTP ``/metrics`` endpoint
   and an end-of-job JSON report.
+- **tracing** — the flight recorder (ISSUE 8): always-on per-thread
+  span rings with Chrome/Perfetto export, cross-process merge and
+  stall attribution; the TIMELINE tier next to the registry's
+  aggregates (``profiler.annotate`` feeds both).
 
 Producers migrated onto it: ``io/retry.py`` (retry/backoff/fault
 counters — ``io_stats()`` stays a bit-compatible view), ``io/split.py``
@@ -20,6 +24,7 @@ counters — ``io_stats()`` stays a bit-compatible view), ``io/split.py``
 histograms), ``utils/profiler.annotate`` (opt-in span histograms).
 """
 
+from . import tracing as tracing
 from .aggregate import ClusterAggregator, merge_snapshots, serve_metrics
 from .export import Reporter, to_json, to_prometheus
 from .registry import (
@@ -50,4 +55,5 @@ __all__ = [
     "split_key",
     "to_json",
     "to_prometheus",
+    "tracing",
 ]

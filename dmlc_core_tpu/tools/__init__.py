@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import contextlib
+import os
 import sys
 from typing import List, Optional
 
@@ -319,9 +320,13 @@ def _cmd_cached(args) -> int:
     import signal
 
     from ..io import blockcache
+    from ..telemetry import tracing
 
     sock = args.socket or blockcache.default_sock_path()
     if args.action == "serve":
+        # the serve process IS the daemon: name it on the merged
+        # flight-recorder timeline next to worker/tracker rows
+        tracing.set_process_label("blockcache-daemon")
         daemon = blockcache.BlockCacheDaemon(
             sock,
             max_bytes=(args.budget_mb << 20) if args.budget_mb else None,
@@ -363,6 +368,107 @@ def _cmd_cached(args) -> int:
         print(f"error: no block-cache daemon at {sock}", file=sys.stderr)
         return 1
     print(json.dumps({"evicted": evicted}))
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    """Operator surface for the flight recorder (telemetry/tracing.py,
+    docs/observability.md):
+
+    - ``dump <pid>``: SIGUSR2 the process — its installed handler
+      writes the span rings to ``DMLC_TRACE_DIR`` (or the temp dir)
+      without stopping it.
+    - ``merge -o out.json in...json``: join per-process trace files
+      from a ``dmlc-submit`` run (workers + cache daemon + tracker)
+      into ONE Perfetto-loadable timeline keyed by rank/pid.
+    - ``report trace.json``: stall attribution — per-stage busy/stall
+      seconds, ring-starvation gaps over ``--gap-ms``, critical-path
+      estimate per process.
+    """
+    import json
+    import signal as _signal
+
+    from ..telemetry import tracing
+
+    if args.action == "dump":
+        # `trace dump 1234` and `trace dump --pid 1234` both work — a
+        # positional pid lands in the inputs list
+        pid = args.pid
+        if not pid and len(args.inputs) == 1 and args.inputs[0].isdigit():
+            pid = int(args.inputs[0])
+        if not pid:
+            print("error: trace dump needs a pid", file=sys.stderr)
+            return 2
+        try:
+            os.kill(pid, _signal.SIGUSR2)
+        except (OSError, AttributeError) as e:
+            print(f"error: cannot signal pid {pid}: {e}",
+                  file=sys.stderr)
+            return 1
+        where = os.environ.get("DMLC_TRACE_DIR") or "its temp dir"
+        print(
+            f"SIGUSR2 sent to {pid}; it dumps "
+            f"dmlc-trace-<label>-{pid}.json into its own "
+            f"DMLC_TRACE_DIR (here: {where})",
+            file=sys.stderr,
+        )
+        return 0
+    if args.action == "merge":
+        if not args.out or len(args.inputs) < 1:
+            print("error: trace merge needs -o OUT and >=1 input",
+                  file=sys.stderr)
+            return 2
+        merged = tracing.merge_traces(args.inputs)
+        tracing.write_trace(merged, args.out)
+        print(
+            f"merged {merged['otherData']['merged']} trace(s), "
+            f"{len(merged['traceEvents'])} events -> {args.out} "
+            f"(load in https://ui.perfetto.dev)",
+            file=sys.stderr,
+        )
+        return 0
+    # report
+    if len(args.inputs) != 1:
+        print("error: trace report takes exactly one trace file",
+              file=sys.stderr)
+        return 2
+    report = tracing.stall_report(
+        tracing.load_trace(args.inputs[0]), gap_ms=args.gap_ms
+    )
+    if args.json:
+        print(json.dumps(report, indent=2))
+        return 0
+    print(f"stall attribution for {args.inputs[0]} "
+          f"(gap threshold {args.gap_ms} ms)")
+    print("\nper-stage time (busy = work, stall = waiting):")
+    stages = sorted(
+        set(report["busy_seconds_by_stage"])
+        | set(report["stall_seconds_by_stage"])
+    )
+    for s in stages:
+        b = report["busy_seconds_by_stage"].get(s)
+        w = report["stall_seconds_by_stage"].get(s)
+        kind = "stall" if w is not None else "busy"
+        secs = w if w is not None else b
+        n = report["span_counts_by_stage"].get(s, 0)
+        print(f"  {s:<24} {kind:<5} {secs:>10.4f}s  ({n} spans)")
+    print("\nthreads (busy/idle inside each thread's span extent):")
+    for name, t in sorted(report["threads"].items()):
+        print(f"  {name:<40} busy {t['busy_seconds']:.4f}s  "
+              f"idle {t['idle_seconds']:.4f}s  "
+              f"wall {t['wall_seconds']:.4f}s")
+    gaps = report["starvation_gaps"]
+    print(f"\nstarvation gaps >= {args.gap_ms} ms: {len(gaps)}")
+    for g in gaps[:10]:
+        print(f"  {g['duration_ms']:>10.2f} ms  {g['stage']:<20} "
+              f"{g['process']} / {g['thread']}")
+    print("\ncritical-path estimate per process:")
+    for proc, c in report["critical_path"].items():
+        top = list(c["attributed_seconds"].items())[:3]
+        attr = ", ".join(f"{k} {v:.3f}s" for k, v in top)
+        print(f"  {proc}: wall {c['wall_seconds']:.3f}s, bottleneck "
+              f"thread {c['bottleneck_thread']} ({attr}; "
+              f"unattributed {c['unattributed_seconds']:.3f}s)")
     return 0
 
 
@@ -547,6 +653,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="serve: loopback /metrics port (0 = off)",
     )
     cd.set_defaults(fn=_cmd_cached)
+
+    tr = sub.add_parser(
+        "trace", help="flight-recorder dump/merge/report (Perfetto)"
+    )
+    tr.add_argument("action", choices=["dump", "merge", "report"])
+    tr.add_argument(
+        "inputs", nargs="*",
+        help="trace JSON files (merge: many; report: one)",
+    )
+    tr.add_argument(
+        "--pid", default=0, type=int,
+        help="dump: process to SIGUSR2 (it writes its own rings)",
+    )
+    tr.add_argument(
+        "-o", "--out", default="",
+        help="merge: output trace JSON path",
+    )
+    tr.add_argument(
+        "--gap-ms", default=10.0, type=float,
+        help="report: minimum wait-span duration counted as a "
+             "starvation gap (default 10)",
+    )
+    tr.add_argument(
+        "--json", action="store_true",
+        help="report: emit the full report as JSON",
+    )
+    tr.set_defaults(fn=_cmd_trace)
 
     ck = sub.add_parser(
         "ckpt", help="inspect/prune checkpoint directories (any URI)"

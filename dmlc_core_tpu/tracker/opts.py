@@ -112,6 +112,17 @@ def get_opts(args: Optional[List[str]] = None):
         "--block-cache-mb", default=0, type=int,
         help="Daemon budget in MB (default $DMLC_BLOCK_CACHE_MB or 1024).",
     )
+    # flight-recorder tracing (telemetry/tracing.py): one trace file
+    # per process of the job — workers, cache daemon, tracker — all
+    # landing in one directory for `tools trace merge`
+    parser.add_argument(
+        "--trace-dir", default=None, type=str,
+        help="Export DMLC_TRACE_DIR to every process of the job "
+             "(tracker, workers, block-cache daemon): each dumps its "
+             "flight-recorder rings there at exit / on SIGUSR2; join "
+             "with 'python -m dmlc_core_tpu.tools trace merge' "
+             "(docs/observability.md).",
+    )
     # tpu-pod backend (TPU-native, no reference analogue)
     parser.add_argument(
         "--tpu-name", default=None, type=str,

@@ -8,6 +8,7 @@ accepted but forgot to route — SURVEY §2.6) plus the TPU-native tpu-pod.
 from __future__ import annotations
 
 import logging
+import os
 import sys
 from typing import List, Optional
 
@@ -33,6 +34,13 @@ def config_logger(args) -> None:
 def main(argv: Optional[List[str]] = None) -> None:
     args = opts.get_opts(argv)
     config_logger(args)
+    if getattr(args, "trace_dir", None):
+        # one env export covers every process of the job: the tracker
+        # (this process), workers and the block-cache daemon inherit
+        # os.environ at launch, and each dumps its flight-recorder
+        # rings into the directory at exit (telemetry/tracing.py)
+        os.makedirs(args.trace_dir, exist_ok=True)
+        os.environ["DMLC_TRACE_DIR"] = args.trace_dir
     get_backend(args.cluster)(args)
 
 

@@ -21,6 +21,7 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..telemetry import ClusterAggregator, serve_metrics
+from ..telemetry import tracing as _tracing
 from .protocol import CMD_METRICS, MAGIC, FramedSocket
 from .topology import get_link_map
 
@@ -460,6 +461,12 @@ class RabitTracker:
                     "%s from %s; assigned rank %d",
                     entry.cmd, entry.host, rank_done,
                 )
+                # rendezvous milestones on the tracker's timeline row:
+                # merged with worker traces they show who straggled in
+                _tracing.instant(
+                    "dmlc:tracker_rank_assigned",
+                    rank=rank_done, cmd=entry.cmd,
+                )
                 if len(started) == n_workers and self.start_time is None:
                     logger.info(
                         "@tracker all of %d nodes are started", n_workers
@@ -484,8 +491,15 @@ class RabitTracker:
                         f"metrics heartbeat from invalid rank "
                         f"{entry.rank}",
                     )
-                    # aggregator validates/drops malformed payloads
-                    self.metrics.update(entry.rank, entry.print_msg or "")
+                    # aggregator validates/drops malformed payloads;
+                    # the flight-recorder span puts each heartbeat
+                    # merge on the tracker's row of a merged timeline
+                    with _tracing.span(
+                        "dmlc:tracker_heartbeat", rank=entry.rank
+                    ):
+                        self.metrics.update(
+                            entry.rank, entry.print_msg or ""
+                        )
                     continue
                 if entry.cmd == "shutdown":
                     check_proto(
@@ -649,6 +663,11 @@ class RabitTracker:
                 logger.warning("telemetry report write failed: %s", e)
 
     def start(self, n_workers: Optional[int] = None) -> None:
+        # the submit process IS the tracker: name it on the merged
+        # flight-recorder timeline (workers carry worker<N> via the
+        # DMLC_ROLE/DMLC_TASK_ID env contract)
+        _tracing.set_process_label("tracker")
+        _tracing.instant("dmlc:tracker_start", n_workers=self.n_workers)
         # loopback telemetry endpoint (GET /metrics = Prometheus text,
         # /metrics.json = full report); DMLC_METRICS_HTTP=0 disables,
         # DMLC_METRICS_PORT pins the port (default: ephemeral)

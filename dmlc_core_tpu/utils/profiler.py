@@ -18,6 +18,11 @@ without making jax a hard dependency of the data layer:
   ``profiler.span_seconds{span=<name>}`` — XProf shows one trace,
   telemetry keeps the distribution across the whole run. Off by
   default: the hot loop pays nothing beyond the existing annotation.
+- span → flight-recorder bridge (ISSUE 8): while the always-on trace
+  ring is enabled (``DMLC_TRACE``, telemetry/tracing.py), every
+  ``annotate`` span also lands on the per-thread ring as a Chrome
+  trace-event — ONE call site feeds XProf, the span histogram and the
+  Perfetto timeline.
 
 StagingPipeline wires ``annotate`` around its pull/stage/wait phases, so
 a trace of a training loop shows exactly where infeed time goes
@@ -27,9 +32,12 @@ a trace of a training loop shows exactly where infeed time goes
 from __future__ import annotations
 
 import os
+import threading
 import time
 from contextlib import contextmanager, nullcontext
 from typing import Dict, Optional
+
+from ..telemetry import tracing as _tracing
 
 __all__ = ["annotate", "enable_histograms", "histograms_enabled", "trace"]
 
@@ -70,10 +78,11 @@ def enable_histograms(on: Optional[bool]) -> None:
 
 
 _SPAN_MEMO_CAP = 256  # span names are static call sites, not data
+_SPAN_MEMO_LOCK = threading.Lock()
 
 
 def _span_hist(name: str):
-    hist = _SPAN_HISTS.get(name)
+    hist = _SPAN_HISTS.get(name)  # lock-free fast path (GIL-atomic get)
     if hist is None:
         from ..telemetry import default_registry  # deferred: cold path only
 
@@ -85,30 +94,42 @@ def _span_hist(name: str):
         # the memo exists to skip the registry lock per span; dynamic
         # names (annotate(f"step_{i}")) must not grow it forever — past
         # the cap, fall through to the registry each call (whose own
-        # cardinality cap collapses the series)
-        if len(_SPAN_HISTS) < _SPAN_MEMO_CAP:
-            _SPAN_HISTS[name] = hist
+        # cardinality cap collapses the series). The cap check and the
+        # insert must be ONE atomic step: concurrent first-annotate
+        # calls racing check-then-set could both insert (overshooting
+        # the cap) and the last writer's histogram would silently
+        # replace the first's — setdefault under a lock keeps exactly
+        # one histogram per name and an exact cap (ISSUE 8 satellite).
+        with _SPAN_MEMO_LOCK:
+            if len(_SPAN_HISTS) < _SPAN_MEMO_CAP:
+                hist = _SPAN_HISTS.setdefault(name, hist)
     return hist
 
 
 class _TimedSpan:
-    """annotate() with histograms on: enter the inner annotation (if
-    any), time the region with perf_counter, observe on exit."""
+    """annotate() with histograms and/or the trace ring on: enter the
+    inner annotation (if any), time the region with perf_counter_ns,
+    observe/record on exit — one clock read feeds both sinks."""
 
-    __slots__ = ("_inner", "_hist", "_t0")
+    __slots__ = ("_inner", "_hist", "_name", "_t0")
 
-    def __init__(self, inner, hist) -> None:
+    def __init__(self, inner, hist, name: Optional[str]) -> None:
         self._inner = inner
         self._hist = hist
+        self._name = name  # non-None = also record on the trace ring
 
     def __enter__(self):
         if self._inner is not None:
             self._inner.__enter__()
-        self._t0 = time.perf_counter()
+        self._t0 = time.perf_counter_ns()
         return self
 
     def __exit__(self, *exc):
-        self._hist.observe(time.perf_counter() - self._t0)
+        dt_ns = time.perf_counter_ns() - self._t0
+        if self._hist is not None:
+            self._hist.observe(dt_ns * 1e-9)
+        if self._name is not None:
+            _tracing.add_complete(self._name, self._t0, dt_ns)
         if self._inner is not None:
             return self._inner.__exit__(*exc)
         return False
@@ -117,11 +138,15 @@ class _TimedSpan:
 def annotate(name: str):
     """Context manager marking a host-side span on the XProf timeline
     (no-op without jax); records the span duration into
-    ``profiler.span_seconds{span=name}`` when histograms are enabled."""
+    ``profiler.span_seconds{span=name}`` when histograms are enabled,
+    and onto the flight-recorder ring (telemetry/tracing.py) while
+    tracing is on — the one seam feeding all three sinks."""
     prof = _jax_profiler()
     inner = prof.TraceAnnotation(name) if prof is not None else None
-    if histograms_enabled():
-        return _TimedSpan(inner, _span_hist(name))
+    hist = _span_hist(name) if histograms_enabled() else None
+    traced = _tracing.enabled()
+    if hist is not None or traced:
+        return _TimedSpan(inner, hist, name if traced else None)
     return inner if inner is not None else nullcontext()
 
 

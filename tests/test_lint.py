@@ -276,6 +276,40 @@ def test_shm_socket_quiet_outside_io_and_in_blockcache(tmp_path):
     ) == []
 
 
+def test_trace_event_literal_flagged_in_library(tmp_path):
+    """L011: Chrome trace-event emission and the trace-file format are
+    one site (telemetry/tracing.py), mirroring L006/L008-L010."""
+    # an event-shaped dict literal ("ph" + "ts" keys)
+    src = 'ev = {"ph": "X", "ts": 1.0, "name": "x"}\n'
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L011"]
+    # the file container shape
+    src = 'out = {"traceEvents": [], "displayTimeUnit": "ms"}\n'
+    assert [c for c, _ in _lib_findings(src, tmp_path)] == ["L011"]
+    # per-line opt-out works like every other rule
+    src = 'ev = {"ph": "X", "ts": 0}  # noqa: L011 (fixture)\n'
+    assert _lib_findings(src, tmp_path) == []
+
+
+def test_trace_event_literal_quiet_on_benign_shapes(tmp_path):
+    # reading keys from a LOADED trace is not emission
+    src = 'x = trace["traceEvents"]\ny = ev.get("ts")\n'
+    assert _lib_findings(src, tmp_path) == []
+    # "ph" or "ts" alone is not the event shape
+    assert _lib_findings('d = {"ph": 7.2}\n', tmp_path) == []
+    assert _lib_findings('d = {"ts": 1.0}\n', tmp_path) == []
+    # scoped to dmlc_core_tpu/ — scripts outside the library may build
+    # whatever dicts they like
+    src = 'ev = {"ph": "X", "ts": 1.0}\n'
+    assert codes(src, tmp_path) == []
+    # the flight recorder itself owns the format and is exempt
+    d = tmp_path / "dmlc_core_tpu" / "telemetry"
+    d.mkdir(parents=True)
+    f = d / "tracing.py"
+    f.write_text('ev = {"ph": "X", "ts": 1.0}\n'
+                 'out = {"traceEvents": [ev]}\n')
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

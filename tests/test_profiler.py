@@ -5,7 +5,7 @@ enabled."""
 
 import pytest
 
-from dmlc_core_tpu.telemetry import default_registry
+from dmlc_core_tpu.telemetry import default_registry, tracing
 from dmlc_core_tpu.utils import profiler
 
 
@@ -23,7 +23,18 @@ def hist_off():
     profiler.enable_histograms(None)
 
 
-def test_annotate_is_noop_context_manager_without_jax(no_jax, hist_off):
+@pytest.fixture
+def trace_off():
+    """Force the flight recorder off (it is on by default, and an
+    enabled ring makes annotate() a recording span, not a no-op)."""
+    tracing.set_enabled(False)
+    yield
+    tracing.set_enabled(None)
+
+
+def test_annotate_is_noop_context_manager_without_jax(
+    no_jax, hist_off, trace_off
+):
     profiler.enable_histograms(False)
     cm = profiler.annotate("dmlc:test")
     with cm as inner:
@@ -86,6 +97,46 @@ def test_annotate_with_jax_still_times_spans(hist_off):
         pass
     snap = default_registry().snapshot()["histograms"][key]
     assert snap["count"] >= 1
+
+
+def test_span_memo_concurrent_first_annotate_race(no_jax, hist_off):
+    """ISSUE 8 satellite: concurrent FIRST annotate() calls must not
+    double-register a span name (last-writer-wins in the memo would
+    hand different threads different histogram objects) nor mis-account
+    the memo cap (racing check-then-set inserts past it). All threads
+    must land their observations on ONE histogram per name."""
+    import threading
+
+    profiler.enable_histograms(True)
+    profiler._SPAN_HISTS.clear()
+    n_threads, n_names = 8, 16
+    seen = [[None] * n_names for _ in range(n_threads)]
+    gate = threading.Barrier(n_threads)
+
+    def worker(slot):
+        gate.wait()  # maximize first-annotate collisions
+        for i in range(n_names):
+            with profiler.annotate(f"dmlc:race_{i}"):
+                pass
+            seen[slot][i] = profiler._SPAN_HISTS.get(f"dmlc:race_{i}")
+
+    threads = [
+        threading.Thread(target=worker, args=(s,))
+        for s in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # one memoized histogram per name, shared by every thread
+    for i in range(n_names):
+        hists = {id(seen[s][i]) for s in range(n_threads)}
+        assert len(hists) == 1, f"name {i} double-registered"
+    assert len(profiler._SPAN_HISTS) == n_names  # cap accounting exact
+    # and every observation landed on that one series
+    key = 'profiler.span_seconds{span="dmlc:race_0"}'
+    snap = default_registry().snapshot()["histograms"][key]
+    assert snap["count"] >= n_threads
 
 
 def test_span_memo_bounded_on_dynamic_names(no_jax, hist_off):

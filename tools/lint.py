@@ -49,6 +49,20 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  non-cache uses — retry.py's socket
                                  exception classification — opt out per
                                  line with `# noqa: L010`.)
+  L011 Chrome trace-event literal in dmlc_core_tpu/ (the flight
+                                 recorder owns trace-event emission and
+                                 the trace-file format:
+                                 telemetry/tracing.py — event schema,
+                                 clock rebasing, drop accounting, the
+                                 traceEvents container — and is exempt;
+                                 everything else records through its
+                                 span/instant/counter API so per-thread
+                                 ordering and drop counters can't be
+                                 bypassed. Flags dict literals shaped
+                                 like an event ({"ph": ..., "ts": ...})
+                                 or like the file ({"traceEvents": ...});
+                                 reading those keys from a loaded trace
+                                 is fine.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -318,6 +332,10 @@ _L007_EXEMPT_DIRS = ("dmlc_core_tpu/staging/", "tests/")
 # dmlc_core_tpu/ — benches and tests measure with perf_counter already,
 # and scripts outside the library may legitimately want wall-clock
 _L008_SCOPE_DIRS = ("dmlc_core_tpu/",)
+# L011 is scoped to dmlc_core_tpu/ and exempts the flight recorder,
+# which owns trace-event emission and the trace-file format
+_L011_SCOPE_DIRS = ("dmlc_core_tpu/",)
+_L011_EXEMPT = ("/telemetry/tracing.py",)
 
 def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
     """Any import binding the ``socket`` module or
@@ -358,6 +376,35 @@ def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
                 )
 
 
+def _check_trace_event_literals(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Dict literals shaped like a Chrome trace event (both ``"ph"``
+    and ``"ts"`` constant keys) or like the trace-file container (a
+    ``"traceEvents"`` constant key): the flight recorder
+    (telemetry/tracing.py) owns the event schema and the file format,
+    mirroring the L006/L008-L010 single-site pattern — ad-hoc event
+    dicts would fork the clock rebasing and dodge the ring's drop
+    accounting. Scoped to dmlc_core_tpu/ in lint_file; reading those
+    keys from a loaded trace (subscripts, .get) is not flagged."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {
+            k.value
+            for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        if "traceEvents" in keys:
+            yield node.lineno, (
+                "trace-file dict literal (the traceEvents container "
+                "belongs to telemetry/tracing.py)"
+            )
+        elif "ph" in keys and "ts" in keys:
+            yield node.lineno, (
+                "Chrome trace-event dict literal (record through the "
+                "flight-recorder API, telemetry/tracing.py)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -369,6 +416,7 @@ CHECKS = [
     ("L008", _check_wall_clock_time),
     ("L009", _check_codec_imports),
     ("L010", _check_shm_socket_imports),
+    ("L011", _check_trace_event_literals),
 ]
 
 
@@ -414,6 +462,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L010_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L010_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L011":
+            if posix.endswith(_L011_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L011_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L011_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
