@@ -412,6 +412,151 @@ def _make_rec_zlib_stream(value_dtype: str):
     )
 
 
+# rec_remote_latency corpus (ISSUE 9): a small zlib shard packed with
+# MANY small blocks (4 KB raw), so a shuffled window's missing blocks
+# scatter into many non-contiguous file spans — the access shape where
+# parallel ranged reads beat one serial connection. The big-block
+# rec_zlib corpus is wrong for this: a window there touches nearly
+# every block and the planner correctly collapses the read into one
+# contiguous span (which the fetcher serves on ONE stream by design).
+REC_REMOTE_ROWS = int(os.environ.get("BENCH_REMOTE_ROWS", "20000"))
+# filename carries the record shape (128B incompressible payloads, 4KB
+# blocks) so a packing change can never silently reuse stale data
+REC_REMOTE_DATA = os.environ.get(
+    "BENCH_REC_REMOTE_DATA",
+    f"/tmp/dmlc_tpu_bench_remote_{REC_REMOTE_ROWS}.zlib4k-r128.rec",
+)
+REC_REMOTE_INDEX = REC_REMOTE_DATA + ".idx"
+# per-span latency injection: fault:// fires a 20 ms sleep every ~2.5
+# read ordinals (spikes budget far above the read count); cap=2048
+# makes a typical 1-2-block span cost 2-4 reads, so every span pays
+# ranged-read latency — the remote shape the fetcher exists to overlap
+REMOTE_FAULT_SPEC = "latency_ms=20,spikes=4000,cap=2048,seed=3"
+
+
+def ensure_rec_remote_data() -> None:
+    if (os.path.exists(REC_REMOTE_DATA)
+            and os.path.getsize(REC_REMOTE_DATA) > 0
+            and os.path.exists(REC_REMOTE_INDEX)
+            and os.path.getsize(REC_REMOTE_INDEX) > 0):
+        return
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream
+
+    # INCOMPRESSIBLE payloads: a digits-only corpus deflates 4KB blocks
+    # to ~100 disk bytes, and a drain over those is all fixed overhead
+    # — real shards keep blocks KB-sized on disk, which is the shape
+    # whose span reads the latency injection must hit
+    rng = np.random.default_rng(31)
+    tmp, tmpi = REC_REMOTE_DATA + ".tmp", REC_REMOTE_INDEX + ".tmp"
+    with FileStream(tmp, "w") as f, FileStream(tmpi, "w") as fi:
+        w = IndexedRecordIOWriter(
+            f, fi, codec="zlib", block_bytes=1 << 12
+        )
+        payloads = rng.integers(
+            0, 255, (REC_REMOTE_ROWS, 120), dtype=np.uint8
+        )
+        for i in range(REC_REMOTE_ROWS):
+            w.write_record(
+                (b"%08d" % i) + payloads[i].tobytes(), i
+            )
+        w.flush_block()
+    os.replace(tmp, REC_REMOTE_DATA)
+    os.replace(tmpi, REC_REMOTE_INDEX)
+
+
+def _remote_latency_bench() -> dict:
+    """The ``rec_remote_latency`` config (ISSUE 9 acceptance): a
+    shuffled window drain over the small-block zlib corpus behind
+    ``fault://`` 20 ms latency spikes — concurrent ranged fetch
+    (``DMLC_FETCH_THREADS=8``) vs the serial one-connection baseline
+    (``DMLC_FETCH_THREADS=1``), same (seed, epoch). The invariant is
+    twofold: the drains are bit-identical (sha256 over the emitted
+    framed bytes — completion order must never leak into epoch order)
+    and the parallel side is >= 3x faster. Host-side only (split
+    layer), so the number is pure fetch overlap, no device noise."""
+    import hashlib
+
+    from dmlc_core_tpu.io import codec as io_codec
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.io.faults import wrap_uri
+
+    ensure_rec_remote_data()
+    uri = wrap_uri(REC_REMOTE_DATA, REMOTE_FAULT_SPEC)
+    # 2 windows: enough spans (~250) for the AIMD ramp to reach its
+    # ceiling, few enough that the private decode cache has not yet
+    # absorbed the block population (later windows miss fewer blocks,
+    # which shrinks the parallelizable span count and dilutes the
+    # ratio toward fixed per-window overhead)
+    n_windows = int(os.environ.get("BENCH_REMOTE_WINDOWS", "2"))
+
+    def drain(threads: int) -> dict:
+        prior = os.environ.get("DMLC_FETCH_THREADS")
+        os.environ["DMLC_FETCH_THREADS"] = str(threads)
+        try:
+            sp = io_split.IndexedRecordIOSplitter(
+                uri, REC_REMOTE_INDEX, 0, 1,
+                shuffle="window", seed=11, window=256, merge_gap=0,
+                readahead=False,
+                # private decode context: the process-global decoded-
+                # block LRU would serve the second drain from memory
+                # and measure nothing
+                decode_ctx=io_codec.DecodeContext(
+                    cache=io_codec.DecodedBlockCache(256 << 20),
+                    shared=None,
+                ),
+            )
+            h = hashlib.sha256()
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                chunk = sp.next_batch_ex(256)
+                if chunk is None:
+                    break
+                h.update(chunk)
+            dt = time.perf_counter() - t0
+            stats = sp.io_stats()
+            sp.close()
+            return {
+                "secs": round(dt, 3),
+                "sha": h.hexdigest(),
+                "rows": stats.get("records", 0),
+                "spans": stats.get("spans", 0),
+                "fetch_concurrency_peak": stats.get(
+                    "fetch_concurrency_peak", 1
+                ),
+                "retries": stats.get("retries", 0),
+            }
+        finally:
+            # restore (not pop): a user-pinned DMLC_FETCH_THREADS must
+            # survive this config for the rest of the bench process
+            if prior is None:
+                os.environ.pop("DMLC_FETCH_THREADS", None)
+            else:
+                os.environ["DMLC_FETCH_THREADS"] = prior
+
+    def best_of(n: int, threads: int) -> dict:
+        # fastest of n: injected sleeps dominate both sides, but on a
+        # loaded 1-core box sleep() overshoot and scheduler hiccups can
+        # swing one sample 2x — the min is the least-contended reading
+        # (the _shared_cache_bench idiom). The sha must agree across
+        # repeats regardless.
+        runs = [drain(threads) for _ in range(n)]
+        assert len({r["sha"] for r in runs}) == 1, "drain not deterministic"
+        return min(runs, key=lambda r: r["secs"])
+
+    serial = best_of(2, 1)
+    parallel = best_of(2, 8)
+    return {
+        "serial": serial,
+        "parallel": parallel,
+        "bit_identical": serial["sha"] == parallel["sha"],
+        "remote_fetch_speedup": round(
+            serial["secs"] / max(parallel["secs"], 1e-9), 2
+        ),
+        "latency_ms": 20,
+    }
+
+
 def ensure_rec_index() -> None:
     """Index file for the bench .rec (uniform frame stride → arithmetic
     offsets; format = IndexedRecordIOWriter's ``key<TAB>offset``)."""
@@ -1092,6 +1237,24 @@ def main() -> None:
     except Exception as e:
         shared_cache = {"skipped": repr(e)}
 
+    # concurrent ranged span fetch vs the one-connection serial
+    # baseline at 20 ms injected span latency (ISSUE 9 acceptance:
+    # >= 3x AND bit-identical). Injected sleeps dominate both sides, so
+    # the ratio is robust to a loaded box; a failure here is the
+    # fetcher, not the weather.
+    try:
+        remote_latency = _remote_latency_bench()
+    except Exception as e:
+        from dmlc_core_tpu.utils.logging import Error as _DmlcError
+
+        remote_latency = {"skipped": repr(e)}
+        # the guard exists for capability-missing hosts; a CHECKED I/O
+        # error (truncated span) or a diverging drain (best_of's sha
+        # assert) is a fetcher regression and must not silently skip
+        # the acceptance invariant
+        if isinstance(e, (_DmlcError, AssertionError)):
+            remote_latency["failed"] = True
+
     # flight-recorder attribution of this very run (ISSUE 8): snapshot
     # the rings BEFORE the overhead probe (its calibration loop wraps
     # the main thread's ring), then measure the recorder's cost — the
@@ -1152,6 +1315,27 @@ def main() -> None:
             f"{trace_overhead['ratio']:.4f}x of DMLC_TRACE=off "
             f"(budget >= 0.97)"
         )
+    # rec_remote_latency invariant (ISSUE 9): parallel fetch must beat
+    # the DMLC_FETCH_THREADS=1 serial baseline >= 3x at 20 ms injected
+    # span latency AND drain bit-identical bytes. Only enforced when
+    # the config ran (exotic hosts skip the config, not the report) —
+    # but a correctness-shaped exception fails the invariant outright.
+    if remote_latency.get("failed"):
+        failures.append(
+            f"rec_remote_latency: {remote_latency['skipped']}"
+        )
+    if "skipped" not in remote_latency:
+        if not remote_latency["bit_identical"]:
+            failures.append(
+                "rec_remote_latency: parallel drain diverged from the "
+                "serial baseline (order/bytes)"
+            )
+        if not (remote_latency["remote_fetch_speedup"] >= 3.0):
+            failures.append(
+                f"rec_remote_latency: concurrent fetch only "
+                f"{remote_latency['remote_fetch_speedup']}x the serial "
+                f"baseline (invariant >= 3x at 20 ms span latency)"
+            )
 
     print(
         json.dumps(
@@ -1190,6 +1374,12 @@ def main() -> None:
                 "rec_zlib_shared_cache": shared_cache,
                 "shared_cache_speedup": shared_cache.get(
                     "shared_cache_speedup"
+                ),
+                # concurrent span fetch vs serial at 20 ms injected
+                # span latency (ISSUE 9): >= 3x, bit-identical
+                "rec_remote_latency": remote_latency,
+                "remote_fetch_speedup": remote_latency.get(
+                    "remote_fetch_speedup"
                 ),
                 **_codec_summary(),
                 # gather/legacy speedup is THE tentpole acceptance

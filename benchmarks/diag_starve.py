@@ -132,6 +132,52 @@ def _print_telemetry() -> None:
     print("telemetry: " + json.dumps(to_json()))
 
 
+def _fetch_threads_arg() -> None:
+    """``--fetch-threads N``: pin the span-fetch concurrency for the
+    drains (exported as DMLC_FETCH_THREADS before any splitter is
+    built; 1 = the serial baseline). Grow it across runs and watch the
+    summary below — the observable version of what the AIMD ramp picks
+    on its own."""
+    if "--fetch-threads" not in sys.argv:
+        return
+    n = sys.argv[sys.argv.index("--fetch-threads") + 1]
+    os.environ["DMLC_FETCH_THREADS"] = str(int(n))
+
+
+def _print_fetch_summary() -> None:
+    """Exit summary of the concurrent span fetcher (ISSUE 9): the peak
+    concurrency the AIMD ramp actually reached plus the consumer-side
+    span_wait_seconds percentiles and stream reopens — the autotune's
+    chosen concurrency, observable outside bench. All zeros when every
+    drain was local (the mmap fast path never engages the fetcher)."""
+    from dmlc_core_tpu.io.spanfetch import fetch_threads
+    from dmlc_core_tpu.telemetry import default_registry
+
+    reg = default_registry()
+    wait = reg.histogram("io.fetch.span_wait_seconds").snapshot()
+    print(
+        "fetch: "
+        + json.dumps(
+            {
+                "fetch_threads": fetch_threads(),
+                "concurrency_peak": reg.gauge(
+                    "io.fetch.concurrency_peak"
+                ).value(),
+                "spans": reg.counter("io.fetch.spans").value(),
+                "mb_fetched": round(
+                    reg.counter("io.fetch.bytes").value() / 1e6, 2
+                ),
+                "reopens": reg.counter("io.fetch.reopens").value(),
+                "span_wait_seconds": {
+                    k: wait[k]
+                    for k in ("count", "p50", "p90", "p99")
+                    if k in wait
+                },
+            }
+        )
+    )
+
+
 def _trace_arg():
     """``--trace <path>``: dump the flight recorder on exit (ISSUE 8)
     so the per-mode numbers above come WITH their timeline. A bare
@@ -160,11 +206,13 @@ def _dump_trace(path) -> None:
 
 def main():
     trace_path = _trace_arg()
+    _fetch_threads_arg()
     if "--shuffle" in sys.argv:
         fault = ""
         if "--fault" in sys.argv:  # e.g. --fault resets=2,errors=1,seed=7
             fault = sys.argv[sys.argv.index("--fault") + 1]
         print(json.dumps(shuffle_read_modes(fault), indent=1))
+        _print_fetch_summary()
         _print_telemetry()
         _dump_trace(trace_path)
         return

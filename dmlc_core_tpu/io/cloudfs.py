@@ -43,6 +43,7 @@ from ..utils.logging import Error, check
 from .filesystem import FS_REGISTRY, FileInfo, FileSystem
 from .retry import HttpError, RetryPolicy, is_transient
 from .retry import request as _retry_request
+from .spanfetch import count_stream_reopen as _count_stream_reopen
 from .stream import SeekStream, Stream
 from .uri import URI
 
@@ -209,6 +210,12 @@ class HttpReadStream(SeekStream):
 
     def seek(self, pos: int) -> None:
         if pos != self._pos:
+            if self._resp is not None:
+                # a live connection torn down by repositioning: the next
+                # read pays a full reconnect (ranged GET). Counted as
+                # io.fetch.reopens so serial-fallback seek storms are
+                # visible in io_stats/bench/`tools trace report`.
+                _count_stream_reopen()
             self._drop()
             self._pos = pos
 

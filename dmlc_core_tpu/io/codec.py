@@ -48,7 +48,7 @@ import threading
 import time as _time
 import zlib as _zlib
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, Iterable, Iterator, List, Optional, Tuple, Union
 
 from ..telemetry import default_registry as _default_registry
@@ -73,6 +73,7 @@ __all__ = [
     "encode_block",
     "get_codec",
     "register_codec",
+    "submit_decode",
     "wire_block_key",
 ]
 
@@ -431,6 +432,24 @@ def decode_blocks(blobs: List[bytes]) -> List[Tuple[bytes, int]]:
     return list(default_decode_pool().map(decode_block, blobs))
 
 
+def submit_decode(blob) -> "Future":
+    """Submit ONE block decode to the shared pool; returns its Future.
+
+    The fetch→decode overlap seam: the concurrent span fetcher
+    (io/spanfetch.py) hands each span's blocks here as the span LANDS,
+    so decompression of early spans runs while later spans are still in
+    flight. With a single-thread pool the decode runs inline and the
+    Future comes back already resolved — same results, serial timing."""
+    if decode_threads() <= 1:
+        f: "Future" = Future()
+        try:
+            f.set_result(decode_block(blob))
+        except Exception as e:  # surfaces at .result(), like a pool job
+            f.set_exception(e)
+        return f
+    return default_decode_pool().submit(decode_block, blob)
+
+
 # -- decoded-block LRU cache --------------------------------------------------
 class DecodedBlockCache:
     """Bytes-bounded LRU of decoded block payloads.
@@ -524,8 +543,9 @@ class DecodeContext:
     """The single seam every block-decode consumer rides: in-process
     LRU (L1), then the host-shared daemon tier (L2, io/blockcache.py),
     then decode — plus the shared decompress pool. The window loader,
-    ``_decoded_block``, and ``decode_chunk`` all go through one of
-    these instead of reaching into module globals, so tests can inject
+    the splitter's ``_fetch_blocks`` miss path, and ``decode_chunk``
+    all go through one of these instead of reaching into module
+    globals, so tests can inject
     a fake daemon or a private LRU, and the two-level policy lives in
     exactly one place.
 
@@ -622,6 +642,9 @@ class DecodeContext:
 
     def decode_blocks(self, blobs: List[bytes]) -> List[Tuple[bytes, int]]:
         return decode_blocks(blobs)
+
+    def submit_decode(self, blob) -> "Future":
+        return submit_decode(blob)
 
 
 _CTX: Optional[DecodeContext] = None

@@ -38,6 +38,7 @@ from ..utils.profiler import annotate
 from . import codec as _codec
 from . import retry as _retry
 from . import serializer
+from . import spanfetch as _spanfetch
 from .filesystem import FileInfo, FileSystem
 from .recordio import (
     RecordIOChunkReader,
@@ -201,8 +202,10 @@ class InputSplitBase(InputSplit):
     ) -> None:
         self.filesys = filesys or FileSystem.get_instance(uri.split(";")[0])
         # retry/fault counters are process-global (io/retry.py); the
-        # snapshot makes io_stats() report this split's delta
+        # snapshot makes io_stats() report this split's delta — same
+        # idiom for the remote-stream reopen counter (io/spanfetch.py)
         self._retry_snap = _retry.stats()
+        self._reopen_snap = _spanfetch.reopens_total()
         self._init_files(uri, recurse_directories)
         self.buffer_size = DEFAULT_BUFFER_BYTES
         self._fs: Optional[Stream] = None
@@ -415,8 +418,15 @@ class InputSplitBase(InputSplit):
         by a fault:// source. Counters are process-global deltas —
         exact when one split is active, overlapping otherwise.
         IndexedRecordIOSplitter extends this with its I/O-shape
-        counters (spans/seeks/bytes)."""
-        return {"mode": "sequential", **_retry.stats_delta(self._retry_snap)}
+        counters (spans/seeks/bytes). ``reopens``: remote stream
+        connections torn down by a repositioning seek since
+        construction (io.fetch.reopens — a serial seek storm over an
+        HTTP backend pays one reconnect per count)."""
+        return {
+            "mode": "sequential",
+            "reopens": _spanfetch.reopens_total() - self._reopen_snap,
+            **_retry.stats_delta(self._retry_snap),
+        }
 
     def close(self) -> None:
         self._close_fs()
@@ -878,27 +888,39 @@ class _SpanReader:
     def read(self, offset: int, size: int):
         """Span bytes at absolute dataset ``offset`` — a zero-copy
         memoryview when one mmapped file covers the span, else joined
-        bytes."""
+        bytes. File-boundary walk shared with the fetcher
+        (``spanfetch.iter_file_segments``)."""
         out: List[bytes] = []
-        while size > 0:
-            fp = bisect.bisect_right(self._file_offset, offset) - 1
-            if fp >= len(self._files):
-                break
-            avail = self._file_offset[fp + 1] - offset
-            if avail <= 0:
-                break
-            take = min(size, avail)
-            data = self._read_in_file(
-                fp, offset - self._file_offset[fp], take
-            )
+        for fp, rel, take, _base in _spanfetch.iter_file_segments(
+            self._file_offset, len(self._files), offset, size
+        ):
+            data = self._read_in_file(fp, rel, take)
             if not data:
                 break
             out.append(data)
-            offset += len(data)
-            size -= len(data)
             if len(data) < take:
                 break
         return out[0] if len(out) == 1 else b"".join(out)
+
+    def readinto(self, offset: int, out: memoryview) -> int:
+        """Fill ``out`` with the span at absolute dataset ``offset``;
+        returns bytes written. The readinto form of ``read`` for the
+        preallocated window buffer: the mmap fast path copies straight
+        from the page cache into the caller's buffer (one memcpy, no
+        intermediate bytes object), so a multi-span window never holds
+        both a parts list and its join."""
+        written = 0
+        for fp, rel, take, base in _spanfetch.iter_file_segments(
+            self._file_offset, len(self._files), offset, len(out)
+        ):
+            data = self._read_in_file(fp, rel, take)
+            if not data:
+                break
+            out[base : base + len(data)] = data
+            written = base + len(data)
+            if len(data) < take:
+                break
+        return written
 
     def close(self) -> None:
         for fd in self._fds.values():
@@ -956,8 +978,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
       window's index entries are sorted by byte offset and merged into
       large spans (``plan_coalesced_spans``, gap threshold
       ``merge_gap``), the spans are read with one positioned read each
-      (``os.pread`` on local files — no seek syscalls, thread-safe),
-      and the window's records are emitted from the client-side buffer
+      (``os.pread``/mmap on local files — no seek syscalls,
+      thread-safe; REMOTE files ride the concurrent span fetcher,
+      io/spanfetch.py — parallel ranged reads on pooled retrying
+      connections, ``DMLC_FETCH_THREADS``/``DMLC_FETCH_INFLIGHT_MB``,
+      with fetch→decode overlap on compressed shards), and the
+      window's records are emitted from the client-side buffer
       in permutation order. A ThreadedIter readahead stage loads window
       k+1's spans while the consumer drains window k. Memory is bounded
       by ~2-3 windows of records; read amplification is bounded by the
@@ -1034,6 +1060,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         self._win_skip = 0
         self._all_local: Optional[bool] = None  # resolved lazily from files
         self._span_reader: Optional[_SpanReader] = None
+        self._span_fetcher: Optional[_spanfetch.SpanFetcher] = None
         # I/O-shape counters (cumulative across epochs; io_stats())
         self.spans_read = 0
         self.seek_calls = 0
@@ -1314,39 +1341,93 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
     def _block_key(self, bid: int) -> object:
         return (self._cache_key, int(self._block_offs[bid]))
 
-    def _fetch_block(self, bid: int) -> bytes:
-        """Read, decode and publish block ``bid`` — the miss path after
-        the two-level lookup already answered empty."""
-        framed = self._read_at(
-            int(self._block_offs[bid]), int(self._block_sizes[bid])
-        )
-        blob, _end = scan_compressed_blob(memoryview(framed), 0)
-        raw, _n = self._decode_ctx.decode_block(blob)
-        self._decode_ctx.put_block(self._block_key(bid), raw)
-        return raw
+    def _get_fetcher(self) -> Optional[_spanfetch.SpanFetcher]:
+        """The concurrent ranged-read engine (io/spanfetch.py) for
+        REMOTE files, or None: local files keep the zero-copy
+        mmap/pread ``_SpanReader`` fast path untouched, and
+        ``DMLC_FETCH_THREADS=1`` pins the serial baseline the
+        ``rec_remote_latency`` bench config scores against."""
+        if self._files_all_local() or _spanfetch.fetch_threads() <= 1:
+            return None
+        if self._span_fetcher is None:
+            self._span_fetcher = _spanfetch.SpanFetcher(
+                self.files, self.file_offset, self.filesys
+            )
+        return self._span_fetcher
 
-    def _decoded_block(self, bid: int) -> bytes:
-        """Decoded raw framed bytes of block ``bid``, through the
-        two-level decode context (io/codec.py DecodeContext: in-process
-        LRU, then the host-shared daemon tier, then read+decode) —
-        multi-epoch and shuffled reads decode each block once while it
-        stays resident, and colocated PROCESSES decode it once per host
-        while a daemon serves it."""
-        data = self._decode_ctx.get_block(self._block_key(bid))
-        if data is not None:
-            self.decode_cache_hits += 1
-            return data
-        self.decode_cache_misses += 1
-        return self._fetch_block(bid)
+    def _get_span_reader(self) -> _SpanReader:
+        if self._span_reader is None:
+            self._span_reader = _SpanReader(
+                self.files, self.file_offset, self.filesys
+            )
+        return self._span_reader
+
+    def _fetch_blocks(self, missing: List[int]) -> Dict[int, bytes]:
+        """Read, decode and publish the given MISSING block ids — the
+        one miss path under ``_load_window_compressed`` and
+        ``_emit_range`` after the two-level lookup answered empty.
+
+        The blocks' file ranges coalesce into spans at block
+        granularity (``merge_gap`` waste bound). Remote files read them
+        as parallel ranged fetches (span fetcher) delivered in
+        COMPLETION order; local files read them serially off the
+        mmap/pread span reader. Either way each span's blocks are
+        submitted to the shared decode pool AS THE SPAN LANDS, so fetch
+        and decompress overlap inside one window instead of decoding
+        only after the whole window joined."""
+        ctx = self._decode_ctx
+        marr = np.asarray(missing, dtype=np.int64)
+        offs = self._block_offs[marr]
+        sizes = self._block_sizes[marr]
+        order, starts, ends = _plan_span_bounds(
+            offs, sizes, self.merge_gap
+        )
+        span_begin = offs[order][starts]
+        run_end = np.maximum.accumulate(offs[order] + sizes[order])
+        span_len = run_end[ends - 1] - span_begin
+        spans = list(zip(span_begin.tolist(), span_len.tolist()))
+        pending: List[Tuple[int, object]] = []  # (bid, decode Future)
+
+        def on_span(si: int, data) -> None:
+            nbytes = spans[si][1]
+            check_eq(len(data), nbytes, "span read truncated")
+            self.spans_read += 1
+            self.bytes_read += nbytes
+            _SPANS.inc()
+            _BYTES_READ.inc(nbytes)
+            mv = memoryview(data)
+            begin = spans[si][0]
+            for k in order[starts[si] : ends[si]].tolist():
+                rel = int(offs[k]) - begin
+                blob, _end = scan_compressed_blob(
+                    mv[rel : rel + int(sizes[k])], 0
+                )
+                pending.append((int(marr[k]), ctx.submit_decode(blob)))
+
+        fetcher = self._get_fetcher() if len(spans) > 1 else None
+        if fetcher is not None:
+            for si, data in fetcher.fetch_iter(spans):
+                on_span(si, data)
+        else:
+            reader = self._get_span_reader()
+            for si, (begin, nbytes) in enumerate(spans):
+                on_span(si, reader.read(begin, nbytes))
+        out: Dict[int, bytes] = {}
+        for bid, fut in pending:
+            raw, _n = fut.result()
+            out[bid] = raw
+            ctx.put_block(self._block_key(bid), raw)
+        return out
 
     def _emit_range(self, lo: int, hi: int) -> bytes:
         """Framed v1 bytes of records [lo, hi) of a compressed file:
         decode each covered block (cache-served), slice by the index's
         in-block offsets. The range's blocks go through the decode
         context in ONE batched lookup (L1 then one shared-tier round
-        trip), then misses read+decode individually. Output is
-        byte-identical to the uncompressed writer's framing for the
-        same records."""
+        trip), then misses ride the coalesced ``_fetch_blocks`` miss
+        path (parallel ranged reads on remote files, decode overlapped
+        span by span). Output is byte-identical to the uncompressed
+        writer's framing for the same records."""
         runs: List[Tuple[int, int, int]] = []  # (bid, first, last) recs
         i = lo
         while i < hi:
@@ -1366,23 +1447,61 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             if raw is not None:
                 self.decode_cache_hits += 1
                 blocks[b] = raw
+        missing = sorted(b for b in uniq if b not in blocks)
+        if missing:
+            self.decode_cache_misses += len(missing)
+            blocks.update(self._fetch_blocks(missing))
         views: Dict[int, memoryview] = {}
         out: List[memoryview] = []
         for b, i, j in runs:
             mv = views.get(b)
             if mv is None:
-                raw = blocks.get(b)
-                if raw is None:
-                    self.decode_cache_misses += 1
-                    raw = self._fetch_block(b)
-                    blocks[b] = raw
-                mv = views[b] = memoryview(raw)
+                mv = views[b] = memoryview(blocks[b])
             start = int(self._rec_inoff[i])
             end = int(self._rec_next[j - 1])
             # memoryview slices: the only copy is the final join (the
             # bytes-slice version copied every run twice)
             out.append(mv[start:] if end < 0 else mv[start:end])
         return b"".join(out)
+
+    def _read_spans(
+        self, span_begin: np.ndarray, span_len: np.ndarray
+    ) -> np.ndarray:
+        """A window's planned spans as ONE uint8 buffer, spans at their
+        planned offsets. A single span stays a zero-copy wrap of the
+        span reader's view (an mmap of the page cache on local files);
+        multiple spans fill one PREALLOCATED buffer in place — readinto
+        on the serial path, parallel ranged reads through the span
+        fetcher on remote backends (``fetch_into`` writes each span at
+        its base as it lands). Either way peak memory is the window
+        buffer itself: no parts list + full-window join copy."""
+        spans = list(zip(span_begin.tolist(), span_len.tolist()))
+        total = int(span_len.sum())
+        n_spans = len(spans)
+        self.spans_read += n_spans
+        self.bytes_read += total
+        _SPANS.inc(n_spans)
+        _BYTES_READ.inc(total)
+        fetcher = self._get_fetcher() if n_spans > 1 else None
+        if fetcher is not None:
+            buf = np.empty(total, dtype=np.uint8)
+            bases = np.concatenate(([0], np.cumsum(span_len)[:-1]))
+            fetcher.fetch_into(spans, memoryview(buf), bases.tolist())
+            return buf
+        reader = self._get_span_reader()
+        if n_spans == 1:
+            begin, nbytes = spans[0]
+            data = reader.read(begin, nbytes)
+            check_eq(len(data), nbytes, "span read truncated")
+            return np.frombuffer(data, dtype=np.uint8)
+        buf = np.empty(total, dtype=np.uint8)
+        mv = memoryview(buf)
+        base = 0
+        for begin, nbytes in spans:
+            got = reader.readinto(begin, mv[base : base + nbytes])
+            check_eq(got, nbytes, "span read truncated")
+            base += nbytes
+        return buf
 
     def _load_window_compressed(
         self, perm: np.ndarray
@@ -1417,47 +1536,11 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         if missing:
             # timeline span with the miss count: a window served from
             # the caches skips this entirely, so the Perfetto row shows
-            # exactly which windows paid a read+decode and how long
+            # exactly which windows paid a fetch+decode and how long
             with _tracing.span(
                 "dmlc:window_span_decode", blocks=len(missing)
             ):
-                if self._span_reader is None:
-                    self._span_reader = _SpanReader(
-                        self.files, self.file_offset, self.filesys
-                    )
-                marr = np.asarray(missing, dtype=np.int64)
-                offs = self._block_offs[marr]
-                sizes = self._block_sizes[marr]
-                order, starts, ends = _plan_span_bounds(
-                    offs, sizes, self.merge_gap
-                )
-                span_begin = offs[order][starts]
-                run_end = np.maximum.accumulate(offs[order] + sizes[order])
-                span_len = run_end[ends - 1] - span_begin
-                blobs: List[bytes] = []
-                blob_bid: List[int] = []
-                for si, (begin, nbytes) in enumerate(
-                    zip(span_begin.tolist(), span_len.tolist())
-                ):
-                    data = self._span_reader.read(begin, nbytes)
-                    check_eq(len(data), nbytes, "span read truncated")
-                    self.spans_read += 1
-                    self.bytes_read += nbytes
-                    _SPANS.inc()
-                    _BYTES_READ.inc(nbytes)
-                    mv = memoryview(data)
-                    for k in order[starts[si] : ends[si]].tolist():
-                        rel = int(offs[k]) - begin
-                        blob, _end = scan_compressed_blob(
-                            mv[rel : rel + int(sizes[k])], 0
-                        )
-                        blobs.append(blob)
-                        blob_bid.append(int(marr[k]))
-                for b, (raw, _n) in zip(
-                    blob_bid, ctx.decode_blocks(blobs)
-                ):
-                    decoded[b] = raw
-                    ctx.put_block(self._block_key(b), raw)
+                decoded.update(self._fetch_blocks(missing))
         lens = np.asarray(
             [len(decoded[b]) for b in uniq.tolist()], dtype=np.int64
         )
@@ -1559,28 +1642,12 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         order, starts, ends = _plan_span_bounds(
             offs, sizes, self.merge_gap
         )
-        if self._span_reader is None:
-            self._span_reader = _SpanReader(
-                self.files, self.file_offset, self.filesys
-            )
         soffs = offs[order]
         s_sorted = sizes[order]
         run_end = np.maximum.accumulate(soffs + s_sorted)
         span_begin = soffs[starts]
         span_len = run_end[ends - 1] - span_begin
-        parts: List[bytes] = []
-        for begin, nbytes in zip(span_begin.tolist(), span_len.tolist()):
-            data = self._span_reader.read(begin, nbytes)
-            check_eq(len(data), nbytes, "span read truncated")
-            parts.append(data)
-            self.spans_read += 1
-            self.bytes_read += nbytes
-            _SPANS.inc()
-            _BYTES_READ.inc(nbytes)
-        buf = np.frombuffer(
-            parts[0] if len(parts) == 1 else b"".join(parts),
-            dtype=np.uint8,
-        )
+        buf = self._read_spans(span_begin, span_len)
         # each sorted entry's start inside buf: offset within its span
         # + the span's base in the concatenation
         counts = ends - starts
@@ -1759,14 +1826,28 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         seeks = self.seek_calls
         if self._span_reader is not None:
             seeks += self._span_reader.seeks
+        if self._span_fetcher is not None:
+            seeks += self._span_fetcher.seeks
         out = {
             "mode": self.shuffle_mode or "sequential",
             "records": self.records_emitted,
             "spans": self.spans_read,
             "seeks": seeks,
             "bytes_read": self.bytes_read,
+            "reopens": _spanfetch.reopens_total() - self._reopen_snap,
             **_retry.stats_delta(self._retry_snap),
         }
+        if self._span_fetcher is not None:
+            # concurrent-fetch shape (remote sources only): spans
+            # actually fetched in parallel and the peak concurrency the
+            # AIMD ramp reached — fetch_spans == spans with peak 1
+            # means the ramp never engaged (contiguous plan or
+            # DMLC_FETCH_THREADS=1 would not create a fetcher at all)
+            out["fetch_spans"] = self._span_fetcher.spans
+            out["fetch_bytes"] = self._span_fetcher.bytes
+            out["fetch_concurrency_peak"] = (
+                self._span_fetcher.concurrency_peak
+            )
         if self.windowed:
             # gather-emission shape: batches/bytes handed out zero-copy
             # vs emissions that fell back to the framed-bytes gather
@@ -1850,6 +1931,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         if self._span_reader is not None:
             self._span_reader.close()
             self._span_reader = None
+        if self._span_fetcher is not None:
+            self._span_fetcher.close()
+            self._span_fetcher = None
         super().close()
 
     def next_chunk(self) -> Optional[bytes]:

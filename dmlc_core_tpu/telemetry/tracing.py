@@ -594,6 +594,7 @@ _WAIT_STAGES = frozenset(
         "transfer_wait",      # consumer blocked on an incomplete transfer
         "retry_backoff",      # remote IO healing a transient failure
         "gather_refill",      # split consumer starved by the window loader
+        "fetch_wait",         # window loader starved by remote span reads
         "slot_wait",
     }
 )

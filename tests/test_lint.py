@@ -310,6 +310,61 @@ def test_trace_event_literal_quiet_on_benign_shapes(tmp_path):
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
 
 
+def test_thread_pool_creation_flagged_in_io(tmp_path):
+    """L012: thread-pool creation inside dmlc_core_tpu/io/ is confined
+    to codec.py's decode pool and spanfetch.py's fetch pool — an ad-hoc
+    executor bypasses the cgroup-aware sizing and the in-flight byte
+    budget."""
+    assert [c for c, _ in _lib_findings(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "p = ThreadPoolExecutor(4)\n", tmp_path)] == ["L012"]
+    assert [c for c, _ in _lib_findings(
+        "import concurrent.futures as cf\n"
+        "p = cf.ThreadPoolExecutor(max_workers=2)\n", tmp_path)
+    ] == ["L012"]
+    assert [c for c, _ in _lib_findings(
+        "from concurrent.futures import ThreadPoolExecutor as TPE\n"
+        "p = TPE(2)\n", tmp_path)] == ["L012"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing.pool import ThreadPool\n"
+        "p = ThreadPool(2)\n", tmp_path)] == ["L012"]
+    # per-line opt-out works like every other rule
+    assert _lib_findings(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "p = ThreadPoolExecutor(2)  # noqa: L012 (fixture)\n", tmp_path
+    ) == []
+
+
+def test_thread_pool_creation_quiet_outside_io_and_in_owners(tmp_path):
+    # scoped to dmlc_core_tpu/io/ — staging/tracker pools are governed
+    # by their own sizing policies, and scripts may do as they like
+    assert codes(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "p = ThreadPoolExecutor(4)\n", tmp_path) == []
+    d = tmp_path / "dmlc_core_tpu" / "staging"
+    d.mkdir(parents=True)
+    f = d / "pipeline.py"
+    f.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "p = ThreadPoolExecutor(4)\n"
+    )
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # the two sanctioned owners are exempt
+    d = tmp_path / "dmlc_core_tpu" / "io"
+    d.mkdir(parents=True)
+    for owner in ("codec.py", "spanfetch.py"):
+        f = d / owner
+        f.write_text(
+            "from concurrent.futures import ThreadPoolExecutor\n"
+            "p = ThreadPoolExecutor(4)\n"
+        )
+        assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # mere Future usage / pool REFERENCES are not creation
+    assert _lib_findings(
+        "from concurrent.futures import Future\nf = Future()\n", tmp_path
+    ) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -1,0 +1,247 @@
+"""Concurrent ranged span fetcher (ISSUE 9 tentpole, io/spanfetch.py).
+
+The contract under test: parallel remote reads change WHEN bytes
+arrive, never what they are — ``fetch_into`` assembles the exact serial
+buffer, ``fetch_iter`` delivers every span once in completion order,
+the in-flight byte budget only throttles (never drops or deadlocks),
+contiguous plans collapse to one connection, and the splitter engages
+the engine for remote-shaped sources only (local files keep the
+zero-copy ``_SpanReader`` fast path; ``DMLC_FETCH_THREADS=1`` pins the
+serial baseline). Byte-identity under chaos lives in test_faults.py /
+test_split_gather.py; this file covers the engine itself.
+"""
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.io import spanfetch
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.io.filesystem import FileSystem
+from dmlc_core_tpu.io.spanfetch import SpanFetcher
+from dmlc_core_tpu.telemetry import default_registry
+from dmlc_core_tpu.utils import Error
+
+
+def _make_file(tmp_path, n_bytes=1 << 16, name="spans.bin", seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 255, n_bytes, dtype=np.uint8).tobytes()
+    p = str(tmp_path / name)
+    with open(p, "wb") as f:
+        f.write(data)
+    return p, data
+
+
+def _fetcher_for(uri, threads=4, inflight=None):
+    fs = FileSystem.get_instance(uri)
+    info = fs.get_path_info(uri)
+    return (
+        SpanFetcher(
+            [info], [0, info.size], fs,
+            threads=threads, inflight_bytes=inflight,
+        ),
+        info.size,
+    )
+
+
+def _scattered_spans(total, n=37, size=700, seed=3):
+    rng = np.random.default_rng(seed)
+    starts = np.sort(
+        rng.choice(total - size, size=n, replace=False)
+    ).tolist()
+    # drop accidental overlaps: keep spans disjoint and non-contiguous
+    spans = []
+    last_end = -1
+    for s in starts:
+        if s > last_end:
+            spans.append((int(s), size))
+            last_end = s + size
+    return spans
+
+
+def test_fetch_into_assembles_exact_bytes(tmp_path):
+    p, data = _make_file(tmp_path)
+    # fault:// with no faults = a remote-shaped seekable backend over
+    # the local file (the same wrapper the chaos suites use)
+    f, total = _fetcher_for(f"fault://seed=1{p}")
+    spans = _scattered_spans(total)
+    sizes = [n for _b, n in spans]
+    out = np.empty(sum(sizes), dtype=np.uint8)
+    bases = [0]
+    for n in sizes[:-1]:
+        bases.append(bases[-1] + n)
+    f.fetch_into(spans, memoryview(out), bases)
+    f.close()
+    want = b"".join(data[b : b + n] for b, n in spans)
+    assert out.tobytes() == want
+    assert f.spans == len(spans)
+    assert f.bytes == sum(sizes)
+    assert f.concurrency_peak >= 2  # the ramp actually went parallel
+
+
+def test_fetch_iter_delivers_every_span_once(tmp_path):
+    p, data = _make_file(tmp_path)
+    f, total = _fetcher_for(f"fault://seed=2{p}")
+    spans = _scattered_spans(total, n=23)
+    seen = {}
+    for si, view in f.fetch_iter(spans):
+        assert si not in seen
+        seen[si] = bytes(view)
+    f.close()
+    assert sorted(seen) == list(range(len(spans)))
+    for si, (b, n) in enumerate(spans):
+        assert seen[si] == data[b : b + n], si
+
+
+def test_tiny_inflight_budget_still_completes(tmp_path):
+    """A budget smaller than any span degrades to one-span-at-a-time —
+    it must never drop or deadlock (the inflight==0 escape)."""
+    p, data = _make_file(tmp_path)
+    f, total = _fetcher_for(f"fault://seed=3{p}", inflight=1)
+    spans = _scattered_spans(total, n=9)
+    got = dict(
+        (si, bytes(v)) for si, v in f.fetch_iter(spans)
+    )
+    f.close()
+    assert len(got) == len(spans)
+    assert f.concurrency_peak == 1  # budget serialized the flight
+    for si, (b, n) in enumerate(spans):
+        assert got[si] == data[b : b + n]
+
+
+def test_contiguous_spans_collapse_to_one_connection(tmp_path):
+    p, data = _make_file(tmp_path, n_bytes=8192)
+    f, total = _fetcher_for(f"fault://seed=4{p}")
+    spans = [(i * 1024, 1024) for i in range(8)]  # byte-adjacent
+    got = dict((si, bytes(v)) for si, v in f.fetch_iter(spans))
+    f.close()
+    assert bytes(b"".join(got[i] for i in range(8))) == data
+    assert f.concurrency_peak == 1  # sequential stream, no ranged race
+
+
+def test_span_past_eof_raises_checked_error(tmp_path):
+    p, _data = _make_file(tmp_path, n_bytes=4096)
+    f, total = _fetcher_for(f"fault://seed=5{p}")
+    with pytest.raises(Error, match="span read truncated"):
+        for _ in f.fetch_iter([(0, 1024), (total - 512, 1024)]):
+            pass
+    f.close()
+
+
+def test_fetch_telemetry_series_tick(tmp_path):
+    reg = default_registry()
+    spans_before = reg.counter("io.fetch.spans").value()
+    bytes_before = reg.counter("io.fetch.bytes").value()
+    wait_before = reg.histogram("io.fetch.span_wait_seconds").snapshot()[
+        "count"
+    ]
+    p, _data = _make_file(tmp_path)
+    f, total = _fetcher_for(f"fault://seed=6{p}")
+    spans = _scattered_spans(total, n=19)
+    for _ in f.fetch_iter(spans):
+        pass
+    f.close()
+    assert (
+        reg.counter("io.fetch.spans").value() - spans_before == len(spans)
+    )
+    assert reg.counter("io.fetch.bytes").value() - bytes_before == sum(
+        n for _b, n in spans
+    )
+    # each parallel-path completion observed one consumer wait
+    assert (
+        reg.histogram("io.fetch.span_wait_seconds").snapshot()["count"]
+        > wait_before
+    )
+    assert reg.gauge("io.fetch.concurrency_peak").value() >= 1
+
+
+def test_http_seek_counts_reopen():
+    """HttpReadStream.seek() to a non-current offset over a LIVE
+    connection tears it down — counted as io.fetch.reopens so a
+    serial-fallback seek storm is visible (ISSUE 9 satellite)."""
+    from dmlc_core_tpu.io.cloudfs import HttpReadStream
+
+    class _Resp:
+        def close(self):
+            pass
+
+    s = HttpReadStream("http://example.invalid/x", size=100)
+    before = spanfetch.reopens_total()
+    s._resp = _Resp()
+    s.seek(37)  # live connection + new offset: one reopen
+    assert spanfetch.reopens_total() - before == 1
+    s.seek(37)  # same offset: no-op
+    assert spanfetch.reopens_total() - before == 1
+    s.seek(55)  # no live connection: repositioning is free
+    assert spanfetch.reopens_total() - before == 1
+    s.close()
+
+
+def test_splitter_engages_fetcher_for_remote_only(tmp_path, monkeypatch):
+    from tests.test_split_gather import make_indexed_rec, records_of
+
+    # the ambient env must not decide this test (a developer exporting
+    # the serial baseline would otherwise see the remote assert fail)
+    monkeypatch.delenv("DMLC_FETCH_THREADS", raising=False)
+    records = records_of(60)
+    p, idx = make_indexed_rec(str(tmp_path), records)
+    local = io_split.IndexedRecordIOSplitter(
+        p, idx, 0, 1, shuffle="window", window=16, seed=2
+    )
+    assert local._get_fetcher() is None  # local: mmap fast path owns it
+    local.close()
+    remote = io_split.IndexedRecordIOSplitter(
+        f"fault://seed=8{p}", idx, 0, 1, shuffle="window", window=16,
+        seed=2,
+    )
+    assert remote._get_fetcher() is not None
+    remote.close()
+    # DMLC_FETCH_THREADS=1 pins the serial baseline even on remote
+    monkeypatch.setenv("DMLC_FETCH_THREADS", "1")
+    serial = io_split.IndexedRecordIOSplitter(
+        f"fault://seed=8{p}", idx, 0, 1, shuffle="window", window=16,
+        seed=2,
+    )
+    assert serial._get_fetcher() is None
+    serial.close()
+
+
+def test_fetch_threads_env_and_default(monkeypatch):
+    monkeypatch.setenv("DMLC_FETCH_THREADS", "7")
+    assert spanfetch.fetch_threads() == 7
+    monkeypatch.delenv("DMLC_FETCH_THREADS")
+    n = spanfetch.fetch_threads()
+    assert 2 <= n <= 16
+    monkeypatch.setenv("DMLC_FETCH_INFLIGHT_MB", "3")
+    assert spanfetch.inflight_budget_bytes() == 3 << 20
+
+
+def test_remote_window_io_stats_carry_fetch_shape(tmp_path, monkeypatch):
+    """A remote windowed drain reports the concurrent-fetch shape:
+    fetch_spans/fetch_bytes/fetch_concurrency_peak next to the classic
+    span/seek counters, and the drained bytes equal the local drain's."""
+    monkeypatch.setenv("DMLC_FETCH_THREADS", "4")
+    from tests.test_split_gather import (
+        drain_records,
+        make_indexed_rec,
+        records_of,
+    )
+
+    records = records_of(120)
+    p, idx = make_indexed_rec(str(tmp_path), records)
+    ref = io_split.IndexedRecordIOSplitter(
+        p, idx, 0, 1, shuffle="window", window=24, merge_gap=0, seed=9
+    )
+    want = drain_records(ref)
+    ref.close()
+    s = io_split.IndexedRecordIOSplitter(
+        f"fault://seed=9{p}", idx, 0, 1, shuffle="window", window=24,
+        merge_gap=0, seed=9,
+    )
+    got = drain_records(s)
+    stats = s.io_stats()
+    s.close()
+    assert got == want
+    assert stats["fetch_spans"] > 0
+    assert stats["fetch_bytes"] > 0
+    assert stats["fetch_concurrency_peak"] >= 1
+    assert stats["reopens"] == 0  # no HTTP streams in this drain

@@ -622,7 +622,8 @@ def test_retry_stats_view_matches_registry_counters():
 
 def test_split_io_stats_golden_keys(tmp_path):
     """InputSplitBase.io_stats() keeps the pre-migration shape: mode +
-    the three retry-delta keys, ints/floats, zero on a clean read."""
+    the three retry-delta keys (plus the ISSUE 9 ``reopens`` stream
+    re-establishment delta), ints/floats, zero on a clean local read."""
     from dmlc_core_tpu.io import split as io_split
 
     p = tmp_path / "x.txt"
@@ -634,6 +635,7 @@ def test_split_io_stats_golden_keys(tmp_path):
     s.close()
     assert stats == {
         "mode": "sequential",
+        "reopens": 0,
         "retries": 0,
         "backoff_secs": 0.0,
         "faults_injected": 0,

@@ -63,6 +63,18 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  or like the file ({"traceEvents": ...});
                                  reading those keys from a loaded trace
                                  is fine.)
+  L012 thread-pool creation in dmlc_core_tpu/io/ (exactly two pools are
+                                 sanctioned: codec.py's decode pool —
+                                 sized by the cgroup/affinity-aware
+                                 usable-CPU count, DMLC_DECODE_THREADS —
+                                 and spanfetch.py's ranged-fetch pool —
+                                 DMLC_FETCH_THREADS + the in-flight
+                                 byte budget. An ad-hoc
+                                 ThreadPoolExecutor/ThreadPool anywhere
+                                 else in io/ bypasses the cgroup-aware
+                                 sizing and the budget; route decode
+                                 work through codec's pool and remote
+                                 reads through SpanFetcher.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -336,6 +348,10 @@ _L008_SCOPE_DIRS = ("dmlc_core_tpu/",)
 # which owns trace-event emission and the trace-file format
 _L011_SCOPE_DIRS = ("dmlc_core_tpu/",)
 _L011_EXEMPT = ("/telemetry/tracing.py",)
+# L012 is scoped to dmlc_core_tpu/io/ and exempts the two sanctioned
+# pool owners: the codec decode pool and the span-fetch pool
+_L012_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
+_L012_EXEMPT = ("/io/codec.py", "/io/spanfetch.py")
 
 def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
     """Any import binding the ``socket`` module or
@@ -405,6 +421,41 @@ def _check_trace_event_literals(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+_POOL_TYPES = ("ThreadPoolExecutor", "ProcessPoolExecutor", "ThreadPool")
+
+
+def _check_thread_pool_creation(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call instantiating an executor/pool type (``ThreadPool
+    Executor(...)``, ``futures.ThreadPoolExecutor(...)``,
+    ``multiprocessing.pool.ThreadPool(...)`` — with or without an
+    import alias): inside dmlc_core_tpu/io/ exactly two pools are
+    sanctioned — codec.py's decode pool and spanfetch.py's ranged-fetch
+    pool, both sized from the cgroup/affinity-aware usable-CPU count
+    with documented env overrides. Scoped in lint_file; everything else
+    in io/ must ride those so the sizing policy and the span fetcher's
+    in-flight byte budget cannot be bypassed."""
+    aliases = set(_POOL_TYPES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in _POOL_TYPES and alias.asname:
+                    aliases.add(alias.asname)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in aliases) or (
+            isinstance(f, ast.Attribute) and f.attr in _POOL_TYPES
+        )
+        if hit:
+            yield node.lineno, (
+                "thread-pool creation in io/ (the decode pool in "
+                "io/codec.py and the span-fetch pool in io/spanfetch.py "
+                "are the sanctioned executors — ad-hoc pools bypass the "
+                "cgroup-aware sizing and the in-flight byte budget)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -417,6 +468,7 @@ CHECKS = [
     ("L009", _check_codec_imports),
     ("L010", _check_shm_socket_imports),
     ("L011", _check_trace_event_literals),
+    ("L012", _check_thread_pool_creation),
 ]
 
 
@@ -471,6 +523,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L011_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L011_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L012":
+            if posix.endswith(_L012_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L012_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L012_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
