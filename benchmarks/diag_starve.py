@@ -11,7 +11,15 @@ starves, is it the read layer? Drain the raw IndexedRecordIOSplitter
 (no parse, no device) in each shuffle mode over the bench shard and
 report rows/s plus the split's seek/span counters — the per-record
 mode's seek storm vs the window mode's coalesced spans is visible here
-without any device noise."""
+without any device noise.
+
+Third question (``--dynamic-shards``): what does tracker-leased
+sharding cost when there is nothing to steal? Start a local tracker
+in-process, drain the whole bench shard through DynamicShardSource
+(every micro-shard leased by this one worker) and print the lease /
+steal summary from both sides — the worker's lease_wait and the
+ledger's granted/reclaimed/stolen — so the protocol overhead and the
+straggler signal are observable outside bench's 3-process config."""
 
 from __future__ import annotations
 
@@ -122,6 +130,69 @@ def shuffle_read_modes(fault: str = ""):
     return out
 
 
+def dynamic_shard_drain(fault: str = ""):
+    """``--dynamic-shards``: drain the bench shard through
+    DynamicShardSource against a local in-process tracker (ISSUE 10).
+    One worker, so every micro-shard is self-leased — the number this
+    isolates is the lease protocol's overhead (round-trips, lease_wait)
+    on top of the identical windowed read path, with the ledger's
+    grant/reclaim/steal shape printed on exit. ``fault`` wraps the DATA
+    reads in a fault:// spec, making the TTL/renew machinery visible
+    (latency spikes stretch shard drains toward the lease TTL)."""
+    import bench
+    from dmlc_core_tpu.io import split as io_split
+    from dmlc_core_tpu.io.faults import wrap_uri
+    from dmlc_core_tpu.tracker.tracker import RabitTracker
+
+    bench.ensure_rec_data()
+    bench.ensure_rec_index()
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    prev_env = {
+        k: os.environ.get(k)
+        for k in ("DMLC_TRACKER_URI", "DMLC_TRACKER_PORT")
+    }
+    os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+    os.environ["DMLC_TRACKER_PORT"] = str(tracker.port)
+    try:
+        uri = (
+            f"{wrap_uri(bench.REC_DATA, fault)}?index={bench.REC_INDEX}"
+            "&shuffle=record&dynamic_shards=1"
+        )
+        s = io_split.create(uri, type="recordio", threaded=False)
+        t0 = time.perf_counter()
+        nbytes = 0
+        while True:
+            g = s.next_gather_batch(4096)
+            if g is None:
+                break
+            nbytes += int(g[2].sum())
+        dt = time.perf_counter() - t0
+        stats = s.io_stats()
+        s.close()
+        return {
+            "drain": {
+                "rows_per_sec": round(stats.get("records", 0) / dt, 1),
+                "mb_per_sec": round(nbytes / dt / 1e6, 1),
+                "secs": round(dt, 3),
+                **stats,
+            },
+            # the ledger's view: granted == completed and stolen == 0
+            # on a healthy single-worker drain; reclaimed > 0 here
+            # means shard drains outlived the lease TTL (renewal rides
+            # the pulls, so that takes a genuine stall)
+            "ledger": tracker.shards.summary(),
+        }
+    finally:
+        tracker.close()
+        # don't leak the dead tracker's address into the process
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _print_telemetry() -> None:
     """Exit dump of the process telemetry registry: every counter the
     drained layers ticked (split shape, retry/fault, staging) in one
@@ -213,6 +284,14 @@ def main():
             fault = sys.argv[sys.argv.index("--fault") + 1]
         print(json.dumps(shuffle_read_modes(fault), indent=1))
         _print_fetch_summary()
+        _print_telemetry()
+        _dump_trace(trace_path)
+        return
+    if "--dynamic-shards" in sys.argv:
+        fault = ""
+        if "--fault" in sys.argv:  # e.g. --fault latency_ms=20,spikes=50
+            fault = sys.argv[sys.argv.index("--fault") + 1]
+        print(json.dumps(dynamic_shard_drain(fault), indent=1))
         _print_telemetry()
         _dump_trace(trace_path)
         return

@@ -60,6 +60,9 @@ class ThreadedParser(Parser):
     def __init__(self, base: Parser, max_capacity: int = 8) -> None:
         self._base = base
         self._first_epoch = True
+        #: bytes consumed by batches DELIVERED to the consumer — see
+        #: bytes_read()
+        self._bytes_delivered = 0
         self._iter: ThreadedIter[List[RowBlock]] = ThreadedIter(
             self._produce, max_capacity=max_capacity, name="threaded-parser"
         )
@@ -75,16 +78,34 @@ class ThreadedParser(Parser):
             blocks = self._base.parse_next()
             if blocks is None:
                 return
-            yield blocks
+            # snapshot the count HERE, on the producer thread, after
+            # parse_next returned: the base is between chunks, so the
+            # number is consistent — and it crosses the queue WITH its
+            # batch, becoming visible only when the batch is delivered
+            yield blocks, self._base.bytes_read()
 
     def parse_next(self) -> Optional[List[RowBlock]]:
-        return self._iter.next()
+        item = self._iter.next()
+        if item is None:
+            return None
+        blocks, watermark = item
+        self._bytes_delivered = watermark
+        return blocks
 
     def before_first(self) -> None:
         self._iter.before_first()
+        self._bytes_delivered = 0
 
     def bytes_read(self) -> int:
-        return self._base.bytes_read()
+        """Bytes of source behind the batches the CONSUMER has seen.
+
+        Reading ``self._base.bytes_read()`` directly races the producer
+        thread, which may be mid-chunk parsing batches still sitting in
+        the queue — over-reporting bytes not yet delivered (and making
+        throughput-per-byte accounting jitter with queue depth). The
+        watermark crosses the queue attached to each batch, so this is
+        exact at every batch boundary."""
+        return self._bytes_delivered
 
     def close(self) -> None:
         self._iter.destroy()

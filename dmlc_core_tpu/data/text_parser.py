@@ -119,6 +119,11 @@ class TextParserBase(Parser):
 
     def close(self) -> None:
         if self._pool is not None:
-            self._pool.shutdown(wait=False)
+            # shutdown(wait=False) returns while parse_block futures
+            # still hold their chunk slices — closing the split under a
+            # live worker is a use-after-close. Cancel what never
+            # started and WAIT for what did; parse_block is pure CPU on
+            # an in-memory slice, so the wait is bounded by one block.
+            self._pool.shutdown(wait=True, cancel_futures=True)
             self._pool = None
         self.source.close()

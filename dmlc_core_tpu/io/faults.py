@@ -55,7 +55,7 @@ from .retry import (
 from .stream import SeekStream, Stream
 from .uri import URI
 
-__all__ = ["FaultInjectingFileSystem", "FaultSpec", "wrap_uri"]
+__all__ = ["FaultInjectingFileSystem", "FaultSpec", "wrap_uri", "unwrap_uri"]
 
 _SPEC_KEYS = (
     "inner",
@@ -116,6 +116,31 @@ def wrap_uri(uri: str, spec: str) -> str:
     if not path.startswith("/"):
         path = "/" + path
     return f"fault://{spec}{path}"
+
+
+def unwrap_uri(uri: str) -> str:
+    """Inverse of :func:`wrap_uri` for IDENTITY purposes: the inner URI
+    a host-form ``fault://`` wrapper points at, unchanged for every
+    other scheme. Consumers that need a stable *dataset* identity (the
+    dynamic shard service's fileset signature — one chaos-wrapped
+    worker must not look like it reads different data than its clean
+    peers) normalize through this; it does not parse query-form specs
+    (those never reach a URI used as an identity — the split factory
+    strips query args into options first)."""
+    if not uri.startswith("fault://"):
+        return uri
+    rest = uri[len("fault://"):]
+    slash = rest.find("/")
+    if slash < 0:
+        return uri
+    spec_seg, path = rest[:slash], rest[slash:]
+    args = dict(
+        kv.split("=", 1) for kv in spec_seg.split(",") if "=" in kv
+    )
+    inner = args.get("inner", "file")
+    if inner == "file":
+        return path
+    return f"{inner}://{path.lstrip('/')}"
 
 
 class _Schedule:

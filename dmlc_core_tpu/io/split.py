@@ -25,6 +25,7 @@ import os
 import random
 import re
 import threading
+import time
 from collections import OrderedDict
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -60,6 +61,7 @@ __all__ = [
     "ThreadedInputSplit",
     "CachedInputSplit",
     "InputSplitShuffle",
+    "DynamicShardSource",
     "create",
     "normalize_shuffle",
     "plan_coalesced_spans",
@@ -2266,6 +2268,347 @@ class InputSplitShuffle(InputSplit):
         self._base.close()
 
 
+class DynamicShardSource(InputSplit):
+    """Tracker-leased dynamic sharding: an InputSplit whose shard →
+    worker placement is decided at RUN time by the tracker's shard
+    service (tracker/shardsvc.py, docs/sharding.md) instead of a
+    ``part_index/num_parts`` fixed at open.
+
+    The file set is oversharded into ``K x num_workers`` micro-shards;
+    a micro-shard IS ``(part_index=i, num_parts=M)`` of the standard
+    byte-range/magic-scan planner, so shard CONTENT — including the
+    per-shard ``(seed, epoch)`` shuffle permutation — is bit-identical
+    to a static run over the same ``M`` parts; only which worker drains
+    which shard changes. The driver pulls a lease, opens the standard
+    (windowed) splitter for that micro-shard via ``make_splitter``,
+    drains it, reports ``shard_done``, and pulls the next — so a slow
+    worker simply takes fewer shards and an idle worker steals the
+    reclaimed ones. Waiting for a grantable shard is surfaced as the
+    ``dmlc:shard_lease_wait`` stall stage on the flight recorder.
+
+    Semantics: committed work is exactly-once (the ``on_shard_done``
+    hook sees ``recorded`` exactly once per micro-shard, cluster-wide);
+    record emission is at-least-once only if a LIVE worker outlives its
+    lease TTL without renewing (renewal rides every pull and every
+    tracker heartbeat). ``before_first()`` starts the next epoch — a
+    fresh cluster-wide ledger — mirroring the static splitters'
+    epoch-increment contract.
+
+    ``make_splitter(shard, num_shards, epoch)`` must build the shard's
+    splitter exactly as the static path would (``create`` wires this
+    up; ``dynamic_shards=True`` / ``&dynamic_shards=1``).
+
+    Hooks (settable attributes): ``on_lease(shard, num_shards)`` fires
+    after a lease is granted, ``on_shard_done(shard, status)`` after
+    the tracker acks a completed shard (status ``recorded`` |
+    ``duplicate``) — tests and bench commit per-shard outputs on
+    ``recorded`` for end-to-end exactly-once accounting.
+    """
+
+    def __init__(
+        self,
+        make_splitter,
+        client=None,
+        epoch: int = 0,
+        fileset: Optional[str] = None,
+        windowed_hint: bool = False,
+        renew_frac: float = 3.0,
+        make_probe=None,
+    ) -> None:
+        if client is None:
+            # lazy import: the lease protocol (sockets) lives with the
+            # tracker — io/ only drives it (lint L010 keeps raw sockets
+            # out of this layer)
+            from ..tracker.shardsvc import ShardLeaseClient
+
+            client = ShardLeaseClient()
+        self._client = client
+        self._make_splitter = make_splitter
+        # introspection-only builder (total_size before any lease):
+        # must NOT start read-ahead, so callers whose make_splitter
+        # wraps in ThreadedInputSplit pass the bare construction here
+        self._make_probe = make_probe or make_splitter
+        self._fileset = fileset
+        self._windowed_hint = windowed_hint
+        self._renew_frac = max(1.5, renew_frac)
+        self.epoch = epoch
+        self._started = False
+        self._exhausted = False
+        self._split: Optional[InputSplit] = None
+        self._probe: Optional[InputSplit] = None
+        self._total_size: Optional[int] = None
+        self._chunk_hint: Optional[int] = None
+        self._lease: Optional[Dict] = None
+        self._last_renew = 0.0
+        self.num_shards: Optional[int] = None
+        self.current_shard: Optional[int] = None
+        # worker-side shape counters (io_stats)
+        self.leases = 0
+        self.shards_recorded = 0
+        self.shards_duplicate = 0
+        self.lease_wait_secs = 0.0
+        self.renews_lost = 0
+        self._closed_stats: Dict[str, float] = {}
+        self.on_lease = None
+        self.on_shard_done = None
+
+    # -- lease machinery -----------------------------------------------------
+    def _ensure_split(self) -> bool:
+        """Hold a live per-shard splitter; False at end of epoch."""
+        while self._split is None:
+            if self._exhausted:
+                return False
+            resp = self._client.lease(self.epoch, self._fileset)
+            status = resp.get("status")
+            if status == "lease":
+                shard = int(resp["shard"])
+                self.num_shards = int(resp["num_shards"])
+                self._lease = resp
+                self.current_shard = shard
+                self.leases += 1
+                self._last_renew = time.monotonic()
+                split = self._make_splitter(
+                    shard, self.num_shards, self.epoch
+                )
+                if self._chunk_hint:
+                    split.hint_chunk_size(self._chunk_hint)
+                self._split = split
+                if self.on_lease is not None:
+                    self.on_lease(shard, self.num_shards)
+            elif status == "wait":
+                # every micro-shard is leased out: park (visibly — this
+                # IS the straggler signal on a merged timeline) until
+                # one completes or a lease expires and is reclaimed
+                backoff = float(resp.get("backoff", 0.1))
+                with annotate("dmlc:shard_lease_wait"):
+                    time.sleep(min(1.0, max(0.01, backoff)))
+                self.lease_wait_secs += backoff
+            elif status == "done":
+                self._exhausted = True
+                return False
+            else:
+                raise Error(
+                    "shard lease request failed: "
+                    f"{resp.get('error', resp)!r}"
+                )
+        return True
+
+    def _maybe_renew(self) -> None:
+        if self._lease is None:
+            return
+        now = time.monotonic()
+        ttl = float(self._lease.get("ttl", 30.0))
+        interval = ttl / self._renew_frac
+        if now - self._last_renew < interval:
+            return
+        self._last_renew = now
+        try:
+            resp = self._client.renew(self.epoch)
+        except (OSError, ConnectionError):
+            # transient: retry SOON (1s, not a full interval — two
+            # hiccups in a row must not eat the whole TTL), but not on
+            # every pull (each attempt can pay a connect timeout)
+            self._last_renew = now - interval + min(1.0, interval / 2.0)
+            return
+        if resp.get("status") == "lost":
+            # keep draining: shard_done dedupes (first finisher wins),
+            # but count it — a nonzero renews_lost means the TTL is too
+            # tight for this worker's stall profile
+            self.renews_lost += 1
+
+    @staticmethod
+    def _merge_stats(dst: Dict[str, object], stats: Dict) -> None:
+        """Numeric counters sum, first non-numeric value wins — ONE
+        merge rule for drained and live shards."""
+        for k, v in stats.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                dst[k] = dst.get(k, 0) + v
+            elif k not in dst:
+                dst[k] = v
+
+    def _accumulate_stats(self, split: InputSplit) -> None:
+        stats = getattr(split, "io_stats", lambda: None)() or {}
+        self._merge_stats(self._closed_stats, stats)
+
+    def _release_lease(self) -> None:
+        """Hand an UNFINISHED lease back to the queue (close /
+        mid-epoch restart). Best-effort on purpose — but not optional
+        in spirit: a process whose rabit heartbeat outlives this source
+        would renew the abandoned lease forever, so only a tracker we
+        cannot reach at all is left to the TTL / supervisor reclaim."""
+        lease = self._lease
+        self._lease = None
+        if lease is None:
+            return
+        try:
+            self._client.release(
+                int(lease.get("epoch", self.epoch)), int(lease["shard"]),
+                self._fileset,
+            )
+        except (OSError, ConnectionError, ValueError, KeyError):
+            pass
+
+    def _shard_finished(self) -> None:
+        split, lease = self._split, self._lease
+        self._split = None
+        self._lease = None
+        if split is not None:
+            self._accumulate_stats(split)
+            split.close()
+        if lease is None:
+            return
+        shard = int(lease["shard"])
+        # the signature rides along so a straggler's done from before a
+        # dataset switch can't land on the new dataset's ledger
+        resp = self._client.done(self.epoch, shard, self._fileset)
+        status = resp.get("status", "error")
+        if status == "recorded":
+            self.shards_recorded += 1
+        elif status == "duplicate":
+            self.shards_duplicate += 1
+        else:
+            # a fully-drained shard the tracker refuses to account
+            # (aged-out epoch, stale dataset signature) means this
+            # worker's rows may double-count a peer's — stop loudly,
+            # don't keep feeding the consumer as if the shard committed
+            raise Error(
+                f"tracker refused shard_done for micro-shard {shard} "
+                f"(epoch {self.epoch}): {resp.get('error', resp)}"
+            )
+        if self.on_shard_done is not None:
+            self.on_shard_done(shard, status)
+
+    def _pull(self, op):
+        """The one leased pull loop behind every emission method:
+        ensure a leased shard is open, keep its lease renewed, delegate
+        to the open splitter, and commit the shard when the delegate
+        drains (None)."""
+        while True:
+            if not self._ensure_split():
+                return None
+            self._maybe_renew()
+            out = op(self._split)
+            if out is not None:
+                self._started = True
+                return out
+            self._shard_finished()
+
+    # -- InputSplit contract -------------------------------------------------
+    def next_record(self) -> Optional[bytes]:
+        return self._pull(lambda s: s.next_record())
+
+    def next_chunk(self) -> Optional[bytes]:
+        return self._pull(lambda s: s.next_chunk())
+
+    def next_batch(self, n_records: int) -> Optional[bytes]:
+        return self._pull(lambda s: s.next_batch(n_records))
+
+    def next_gather_batch(self, n_records: int):
+        """Zero-copy gather emission, delegated per micro-shard (the
+        fused staging path). A call never crosses a shard boundary —
+        short returns at shard edges are normal, like window edges."""
+        check(
+            self._windowed_hint,
+            "next_gather_batch needs a windowed shuffle configuration",
+        )
+        return self._pull(lambda s: s.next_gather_batch(n_records))
+
+    @property
+    def windowed(self) -> bool:
+        return self._windowed_hint
+
+    def supports_gather(self) -> bool:
+        return self._windowed_hint
+
+    def count_gather_fallback(self, n: int = 1) -> None:
+        if self._split is not None and hasattr(
+            self._split, "count_gather_fallback"
+        ):
+            self._split.count_gather_fallback(n)
+
+    def before_first(self) -> None:
+        """Next epoch: a fresh cluster-wide ledger. Before anything was
+        pulled this is a no-op (the constructor's ``epoch`` is the
+        first epoch), mirroring the static splitters' increment-per-
+        rewind contract. A live lease is released back to the queue
+        (cmd=shard_release); normal flow drains to None first, so this
+        only costs work on an explicit mid-epoch restart."""
+        if not self._started and not self._exhausted:
+            return
+        if self._split is not None:
+            self._accumulate_stats(self._split)
+            self._split.close()
+            self._split = None
+        self._release_lease()
+        self.epoch += 1
+        self._exhausted = False
+        self._started = False
+        self.current_shard = None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise Error(
+            "DynamicShardSource has no static partition to reset: shard "
+            "placement is leased from the tracker (docs/sharding.md); "
+            "open a static split (part_index/num_parts) if you need "
+            "pinned placement"
+        )
+
+    def _get_probe(self) -> InputSplit:
+        """A (0, 1) splitter used only for whole-set introspection
+        (total_size, extract_records before any lease) — never read."""
+        if self._probe is None:
+            self._probe = self._make_probe(0, 1, self.epoch)
+        return self._probe
+
+    def total_size(self) -> int:
+        if self._total_size is None:
+            src = self._split if self._split is not None else self._get_probe()
+            self._total_size = src.total_size()
+        return self._total_size
+
+    def hint_chunk_size(self, nbytes: int) -> None:
+        self._chunk_hint = nbytes
+        if self._split is not None:
+            self._split.hint_chunk_size(nbytes)
+
+    def extract_records(self, chunk: bytes) -> Iterator[bytes]:
+        src = self._split if self._split is not None else self._get_probe()
+        return src.extract_records(chunk)
+
+    def io_stats(self) -> Dict[str, object]:
+        """Numeric counters summed across every drained micro-shard's
+        splitter plus the live one, with the lease shape on top
+        (``leases``/``shards_recorded``/``shards_duplicate``/
+        ``lease_wait_secs``/``renews_lost``) — docs/sharding.md."""
+        out: Dict[str, object] = dict(self._closed_stats)
+        if self._split is not None:
+            live = getattr(self._split, "io_stats", lambda: None)() or {}
+            self._merge_stats(out, live)
+        inner_mode = out.get("mode", "sequential")
+        out["mode"] = f"dynamic:{inner_mode}"
+        out["leases"] = self.leases
+        out["shards_recorded"] = self.shards_recorded
+        out["shards_duplicate"] = self.shards_duplicate
+        out["lease_wait_secs"] = round(self.lease_wait_secs, 4)
+        out["renews_lost"] = self.renews_lost
+        if self.num_shards is not None:
+            out["num_shards"] = self.num_shards
+        return out
+
+    def close(self) -> None:
+        # a live lease is released, not completed — the partially
+        # drained shard goes back to the queue to be re-served in full
+        # (TTL / supervisor reclaim only cover a tracker we can't reach)
+        if self._split is not None:
+            self._accumulate_stats(self._split)
+            self._split.close()
+            self._split = None
+        self._release_lease()
+        if self._probe is not None:
+            self._probe.close()
+            self._probe = None
+
+
 def create(
     uri: str,
     part_index: int = 0,
@@ -2282,6 +2625,7 @@ def create(
     skip_records: int = 0,
     window: Optional[int] = None,
     merge_gap: Optional[int] = None,
+    dynamic_shards: Optional[bool] = None,
 ) -> InputSplit:
     """InputSplit factory (reference InputSplit::Create, src/io.cc:81-130).
 
@@ -2294,6 +2638,16 @@ def create(
     - ``type``: 'text' | 'recordio' | 'indexed_recordio'
     - ``window``/``merge_gap``: shuffle='window' knobs
       (``?shuffle=window&window=N&merge_gap=B`` as URI sugar)
+    - ``dynamic_shards`` (``&dynamic_shards=1``): ignore the static
+      ``part_index/num_parts`` placement and pull tracker-leased
+      micro-shards instead (DynamicShardSource, docs/sharding.md) —
+      each micro-shard opens the standard splitter with the same
+      options, so per-shard order matches the static path bit-for-bit.
+      Requires a running tracker (``DMLC_TRACKER_URI``/``PORT``).
+      The driver is returned bare; each leased micro-shard's splitter
+      gets the same wrapper a static drain would (windowed splitters
+      prefetch internally, others ride ``ThreadedInputSplit`` when
+      ``threaded``).
     """
     check(
         num_parts >= 1 and 0 <= part_index < num_parts,
@@ -2353,34 +2707,121 @@ def create(
     batch_size = 256 if batch_size is None else batch_size
     if type == "text" and spec.uri == "-":
         return SingleFileSplit("-")
-    if type == "text":
-        base: InputSplitBase = LineSplitter(
-            spec.uri, part_index, num_parts, recurse_directories=recurse_directories
-        )
-    elif type == "recordio":
-        base = RecordIOSplitter(
-            spec.uri, part_index, num_parts, recurse_directories=recurse_directories
-        )
-    elif type == "indexed_recordio":
+    if type not in ("text", "recordio", "indexed_recordio"):
+        raise Error(f"unknown InputSplit type {type!r}")
+    if type == "indexed_recordio":
         check(index_uri is not None, "indexed_recordio requires index_uri")
-        base = IndexedRecordIOSplitter(
+    legacy = legacy_shuffle if type == "indexed_recordio" else False
+
+    def _build_base(pi: int, nparts: int, ep: int) -> InputSplitBase:
+        """The one construction site for both placements: the static
+        path calls it once with (part_index, num_parts, epoch); the
+        dynamic driver calls it per leased micro-shard with
+        (shard, K*num_workers, current_epoch) — identical options, so
+        shard content and per-shard shuffle order never depend on who
+        drains it."""
+        if type == "text":
+            return LineSplitter(
+                spec.uri, pi, nparts,
+                recurse_directories=recurse_directories,
+            )
+        if type == "recordio":
+            return RecordIOSplitter(
+                spec.uri, pi, nparts,
+                recurse_directories=recurse_directories,
+            )
+        return IndexedRecordIOSplitter(
             spec.uri,
             index_uri,  # type: ignore[arg-type]
-            part_index,
-            num_parts,
+            pi,
+            nparts,
             batch_size=batch_size,
             shuffle=shuffle,
             seed=seed,
-            epoch=epoch,
+            epoch=ep,
             skip_records=skip_records,
             # the indexed branch above resolved both (kwarg > URI >
             # default), so they are never None here
             window=window,  # type: ignore[arg-type]
             merge_gap=merge_gap,  # type: ignore[arg-type]
-            legacy_shuffle=legacy_shuffle,
+            legacy_shuffle=legacy,
         )
-    else:
-        raise Error(f"unknown InputSplit type {type!r}")
+
+    if dynamic_shards is None:
+        dynamic_shards = bool(uri_int(spec.args, "dynamic_shards", 0))
+    if dynamic_shards:
+        check(
+            not spec.cache_file,
+            "dynamic_shards with a #cachefile would freeze one worker's "
+            "shard sequence into the cache; pick one",
+        )
+        check(
+            num_shuffle_parts == 0,
+            "dynamic_shards already shuffles placement; num_shuffle_parts "
+            "composes only with static shards",
+        )
+        check(
+            skip_records == 0,
+            "skip_records requires static sharding: mid-epoch resume "
+            "under dynamic shards is ledger-owned (completed micro-shards "
+            "are simply not re-served — docs/sharding.md)",
+        )
+        windowed_hint = (
+            type == "indexed_recordio"
+            and shuffle in ("record", "batch", "window")
+            and not legacy
+        )
+        # dataset signature: mismatched workers (different URIs on the
+        # same tracker) must fail loudly, not drain different bytes.
+        # fault:// wrappers are normalized away — a chaos-wrapped worker
+        # reads the SAME dataset as its clean peers — and local paths
+        # are canonicalized the way wrap_uri canonicalizes them (strip
+        # file://, lead with /) so a clean file:///d/x.rec peer signs
+        # identically to a faulted /d/x.rec one
+        from .faults import unwrap_uri as _unwrap
+
+        def _sig_norm(u: str) -> str:
+            u = _unwrap(u)
+            if u.startswith("file://"):
+                u = u[len("file://"):]
+            if u and "://" not in u and not u.startswith("/"):
+                u = "/" + u
+            return u
+
+        sig = hashlib.sha1(
+            f"{_sig_norm(spec.uri)}|{_sig_norm(index_uri or '')}|{type}"
+            .encode()
+        ).hexdigest()
+        try:
+            from ..tracker.shardsvc import ShardLeaseClient
+
+            client = ShardLeaseClient()
+        except KeyError as e:
+            raise Error(
+                "dynamic_shards needs a tracker: set DMLC_TRACKER_URI/"
+                f"DMLC_TRACKER_PORT (missing {e}) — docs/sharding.md"
+            ) from None
+
+        def _make_leased(pi: int, nparts: int, ep: int) -> InputSplit:
+            # same wrapper rule as the static tail below: windowed
+            # splitters prefetch internally, everything else keeps the
+            # read-ahead thread a static drain would have
+            b = _build_base(pi, nparts, ep)
+            if threaded and not (
+                isinstance(b, IndexedRecordIOSplitter) and b.windowed
+            ):
+                return ThreadedInputSplit(b)
+            return b
+
+        return DynamicShardSource(
+            _make_leased,
+            client=client,
+            epoch=epoch,
+            fileset=sig,
+            windowed_hint=windowed_hint,
+            make_probe=_build_base,
+        )
+    base: InputSplitBase = _build_base(part_index, num_parts, epoch)
     split: InputSplit = base
     if num_shuffle_parts > 0:
         check(

@@ -595,6 +595,8 @@ _WAIT_STAGES = frozenset(
         "retry_backoff",      # remote IO healing a transient failure
         "gather_refill",      # split consumer starved by the window loader
         "fetch_wait",         # window loader starved by remote span reads
+        "shard_lease_wait",   # dynamic-shard worker idle: every micro-shard
+                              # is leased out (or the tracker is slow)
         "slot_wait",
     }
 )

@@ -19,7 +19,15 @@ import socket
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
-from .protocol import CMD_METRICS, MAGIC, FramedSocket
+from .protocol import (
+    CMD_METRICS,
+    CMD_PRINT,
+    CMD_RECOVER,
+    CMD_SHUTDOWN,
+    CMD_START,
+    FramedSocket,
+    connect_worker,
+)
 
 __all__ = ["RabitWorker"]
 
@@ -55,19 +63,9 @@ class RabitWorker:
 
     # -- tracker connection helpers -----------------------------------------
     def _connect_tracker(self, cmd: str, rank: int, world: int) -> FramedSocket:
-        sock = socket.create_connection(
-            (self.tracker_uri, self.tracker_port), timeout=30
+        return connect_worker(
+            self.tracker_uri, self.tracker_port, rank, world, self.jobid, cmd
         )
-        fs = FramedSocket(sock)
-        fs.send_int(MAGIC)
-        got = fs.recv_int()
-        if got != MAGIC:
-            raise ConnectionError(f"tracker sent bad magic {got:#x}")
-        fs.send_int(rank)
-        fs.send_int(world)
-        fs.send_str(str(self.jobid))
-        fs.send_str(cmd)
-        return fs
 
     # -- rendezvous ----------------------------------------------------------
     def start(self, world_size: int = -1, recover_rank: int = -1) -> int:
@@ -81,9 +79,15 @@ class RabitWorker:
         self._listener.listen(16)
         my_port = self._listener.getsockname()[1]
 
-        cmd = "recover" if recover_rank >= 0 else "start"
+        cmd = CMD_RECOVER if recover_rank >= 0 else CMD_START
         fs = self._connect_tracker(cmd, recover_rank, world_size)
         self.rank = fs.recv_int()
+        # bind the shard-lease identity to the rendezvous rank: ranks
+        # are batch-assigned in connect order, so they need not equal
+        # DMLC_TASK_ID — but cmd=metrics heartbeats renew shard leases
+        # BY rendezvous rank, so a lease client in this process must
+        # lease under the same number (tracker/shardsvc.py)
+        os.environ["DMLC_SHARD_RANK"] = str(self.rank)
         self.parent = fs.recv_int()
         self.world_size = fs.recv_int()
         n_tree = fs.recv_int()
@@ -180,7 +184,7 @@ class RabitWorker:
     def log(self, msg: str) -> None:
         """Relay a message through the tracker (cmd=print,
         reference tracker.py:269-271)."""
-        fs = self._connect_tracker("print", self.rank, -1)
+        fs = self._connect_tracker(CMD_PRINT, self.rank, -1)
         fs.send_str(msg)
         fs.close()
 
@@ -211,7 +215,7 @@ class RabitWorker:
 
     def shutdown(self) -> None:
         """Signal completion (cmd=shutdown, reference tracker.py:272-277)."""
-        fs = self._connect_tracker("shutdown", self.rank, -1)
+        fs = self._connect_tracker(CMD_SHUTDOWN, self.rank, -1)
         fs.close()
         self.close()
 

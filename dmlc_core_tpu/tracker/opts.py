@@ -112,6 +112,24 @@ def get_opts(args: Optional[List[str]] = None):
         "--block-cache-mb", default=0, type=int,
         help="Daemon budget in MB (default $DMLC_BLOCK_CACHE_MB or 1024).",
     )
+    # dynamic shard service (tracker/shardsvc.py, docs/sharding.md):
+    # the tracker leases micro-shards to whoever is idle; these knobs
+    # shape the ledger. Workers opt IN per dataset (create(...,
+    # dynamic_shards=True) / &dynamic_shards=1), so the flags only set
+    # policy, they do not switch sharding modes by themselves.
+    parser.add_argument(
+        "--shard-oversplit", default=0, type=int,
+        help="Micro-shards per worker for dynamic sharding (exports "
+             "DMLC_SHARD_OVERSPLIT; default 4). Higher = finer-grained "
+             "work stealing, more lease round-trips.",
+    )
+    parser.add_argument(
+        "--shard-lease-ttl", default=0.0, type=float,
+        help="Seconds a shard lease survives without a renew before "
+             "the tracker reclaims it (exports DMLC_SHARD_LEASE_TTL; "
+             "default 30). Renewal rides worker pulls and metrics "
+             "heartbeats.",
+    )
     # flight-recorder tracing (telemetry/tracing.py): one trace file
     # per process of the job — workers, cache daemon, tracker — all
     # landing in one directory for `tools trace merge`

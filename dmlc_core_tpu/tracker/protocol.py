@@ -9,9 +9,18 @@ Commands ride the handshake's length-prefixed cmd string. The reference
 set is {start, recover, shutdown, print}; this rebuild adds
 ``CMD_METRICS``: a worker heartbeat carrying ONE length-prefixed JSON
 payload (a compact telemetry registry snapshot — docs/observability.md)
-that the tracker aggregates per rank and cluster-wide. Purely additive:
-a reference tracker that never sees the command is unaffected, and the
-payload reuses the existing string framing (MAX_STR bounds it).
+that the tracker aggregates per rank and cluster-wide, and the dynamic
+shard service commands ``CMD_SHARD_LEASE``/``CMD_SHARD_RENEW``/
+``CMD_SHARD_DONE``/``CMD_SHARD_RELEASE`` (docs/sharding.md): each
+carries ONE length-prefixed
+JSON request and receives ONE length-prefixed JSON response on the same
+connection. Purely additive: a reference tracker that never sees these
+commands is unaffected, and every payload reuses the existing string
+framing (MAX_STR bounds it).
+
+This module is the ONLY place command strings are spelled out (lint
+L013): every other module compares/sends the ``CMD_*`` constants, so a
+typo'd command can't silently become an unknown-cmd drop.
 """
 
 from __future__ import annotations
@@ -21,10 +30,48 @@ import struct
 
 MAGIC = 0xFF99
 
+#: the reference rendezvous set (tracker.py accept loop)
+CMD_START = "start"
+CMD_RECOVER = "recover"
+CMD_SHUTDOWN = "shutdown"
+CMD_PRINT = "print"
 #: worker → tracker telemetry heartbeat (cmd string on the handshake)
 CMD_METRICS = "metrics"
+#: dynamic shard service (tracker/shardsvc.py): request a micro-shard
+#: lease / extend held leases / record a completed micro-shard /
+#: voluntarily hand an unfinished lease back to the queue
+CMD_SHARD_LEASE = "shard_lease"
+CMD_SHARD_RENEW = "shard_renew"
+CMD_SHARD_DONE = "shard_done"
+CMD_SHARD_RELEASE = "shard_release"
 
-__all__ = ["CMD_METRICS", "MAGIC", "FramedSocket"]
+#: commands answered by the shard service with ONE JSON response frame
+SHARD_CMDS = frozenset(
+    {CMD_SHARD_LEASE, CMD_SHARD_RENEW, CMD_SHARD_DONE, CMD_SHARD_RELEASE}
+)
+
+#: every command the tracker understands (lint L013 bans spelling these
+#: strings outside this module)
+RENDEZVOUS_CMDS = frozenset(
+    {CMD_START, CMD_RECOVER, CMD_SHUTDOWN, CMD_PRINT, CMD_METRICS}
+) | SHARD_CMDS
+
+__all__ = [
+    "CMD_START",
+    "CMD_RECOVER",
+    "CMD_SHUTDOWN",
+    "CMD_PRINT",
+    "CMD_METRICS",
+    "CMD_SHARD_LEASE",
+    "CMD_SHARD_RENEW",
+    "CMD_SHARD_DONE",
+    "CMD_SHARD_RELEASE",
+    "SHARD_CMDS",
+    "RENDEZVOUS_CMDS",
+    "MAGIC",
+    "FramedSocket",
+    "connect_worker",
+]
 
 
 class FramedSocket:
@@ -70,3 +117,34 @@ class FramedSocket:
             self.sock.close()
         except OSError:
             pass
+
+
+def connect_worker(
+    host: str,
+    port: int,
+    rank: int,
+    world_size: int,
+    jobid: str,
+    cmd: str,
+    timeout: float = 30.0,
+) -> FramedSocket:
+    """Dial the tracker and complete the client-side preamble every
+    worker connection shares — magic exchange, then rank / world_size /
+    jobid / cmd (the frame order WorkerEntry reads). THE one handshake
+    site: RabitWorker and ShardLeaseClient both ride it, so a protocol
+    preamble change cannot drift between them."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        fs = FramedSocket(sock)
+        fs.send_int(MAGIC)
+        got = fs.recv_int()
+        if got != MAGIC:
+            raise ConnectionError(f"tracker sent bad magic {got:#x}")
+        fs.send_int(rank)
+        fs.send_int(world_size)
+        fs.send_str(str(jobid))
+        fs.send_str(cmd)
+        return fs
+    except BaseException:
+        sock.close()
+        raise

@@ -34,6 +34,14 @@ def config_logger(args) -> None:
 def main(argv: Optional[List[str]] = None) -> None:
     args = opts.get_opts(argv)
     config_logger(args)
+    if getattr(args, "shard_oversplit", 0):
+        # env, not plumbing: the tracker process reads it when its
+        # ShardService pins the micro-shard count, and workers inherit
+        # it for their own display/diagnostics (the count they actually
+        # use always comes from the lease response)
+        os.environ["DMLC_SHARD_OVERSPLIT"] = str(args.shard_oversplit)
+    if getattr(args, "shard_lease_ttl", 0.0):
+        os.environ["DMLC_SHARD_LEASE_TTL"] = str(args.shard_lease_ttl)
     if getattr(args, "trace_dir", None):
         # one env export covers every process of the job: the tracker
         # (this process), workers and the block-cache daemon inherit

@@ -22,7 +22,18 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..telemetry import ClusterAggregator, serve_metrics
 from ..telemetry import tracing as _tracing
-from .protocol import CMD_METRICS, MAGIC, FramedSocket
+from . import shardsvc as _shardsvc
+from .protocol import (
+    CMD_METRICS,
+    CMD_PRINT,
+    CMD_RECOVER,
+    CMD_SHUTDOWN,
+    CMD_START,
+    MAGIC,
+    SHARD_CMDS,
+    FramedSocket,
+)
+from .supervisor import RendezvousNeverCompleted
 from .topology import get_link_map
 
 __all__ = [
@@ -335,6 +346,13 @@ class RabitTracker:
         self.metrics_report: Optional[Dict[str, object]] = None
         self.metrics_port: Optional[int] = None
         self._metrics_server = None
+        # dynamic shard service (shardsvc.py, docs/sharding.md): a
+        # leased micro-shard work queue riding this tracker's socket —
+        # idle until the first cmd=shard_lease arrives, so static jobs
+        # pay nothing. Registered process-globally so the supervisor's
+        # failure hook can reclaim a dead task's leases immediately.
+        self.shards = _shardsvc.ShardService(n_workers)
+        _shardsvc.set_active(self.shards)
         logger.info("start listen on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
@@ -365,10 +383,22 @@ class RabitTracker:
         slow-loris client burns only this thread's timeout."""
         try:
             entry = WorkerEntry(conn, addr)
-            if entry.cmd in ("print", CMD_METRICS):
+            if entry.cmd in (CMD_PRINT, CMD_METRICS) or entry.cmd in SHARD_CMDS:
                 # read the one-string payload here too — it is the other
                 # blocking recv a hostile client could stall on
                 entry.print_msg = entry.sock.recv_str()
+            if entry.cmd in SHARD_CMDS:
+                # shard lease traffic is answered HERE, off the state
+                # thread: the ledger has its own lock, the state machine
+                # never blocks on a lease client, and lease latency does
+                # not ride the event queue. One request frame in, one
+                # JSON response frame out, connection closed.
+                resp = self.shards.handle(
+                    entry.cmd, entry.rank, entry.print_msg or ""
+                )
+                entry.sock.send_str(resp)
+                entry.sock.close()
+                return
         except (ConnectionError, OSError) as e:
             logger.warning("bad handshake: %s", e)
             conn.close()
@@ -442,6 +472,9 @@ class RabitTracker:
             flush_deferred()
             if kind == "stop":
                 logger.info("@tracker stopped before job completion")
+                # report whatever aggregated — a closed-early job still
+                # wants its telemetry/shard accounting surfaced
+                self._finish_metrics_report()
                 return
             if kind == "assign_failed":
                 logger.warning(
@@ -457,6 +490,9 @@ class RabitTracker:
                 started.add(rank_done)
                 if entry.jobid != "NULL":
                     job_map[entry.jobid] = rank_done
+                    # supervisor reclaim is task-keyed; leases are held
+                    # by rendezvous rank — record the translation
+                    self.shards.note_task_rank(entry.jobid, rank_done)
                 logger.debug(
                     "%s from %s; assigned rank %d",
                     entry.cmd, entry.host, rank_done,
@@ -477,7 +513,7 @@ class RabitTracker:
             # THIS connection; the state machine must keep serving the rest
             # of the job (VERDICT r1 weak #8 — the reference dies here).
             try:
-                if entry.cmd == "print":
+                if entry.cmd == CMD_PRINT:
                     msg = entry.print_msg or ""
                     self.messages.append(msg.strip())
                     logger.info("%s", msg.strip())
@@ -500,8 +536,12 @@ class RabitTracker:
                         self.metrics.update(
                             entry.rank, entry.print_msg or ""
                         )
+                        # a heartbeat proves the worker is alive: extend
+                        # its shard leases so the ledger only reclaims
+                        # work from workers that actually went silent
+                        self.shards.renew_all(entry.rank)
                     continue
-                if entry.cmd == "shutdown":
+                if entry.cmd == CMD_SHUTDOWN:
                     check_proto(
                         0 <= entry.rank < n_workers,
                         f"shutdown from invalid rank {entry.rank}",
@@ -519,17 +559,22 @@ class RabitTracker:
                     logger.debug("shutdown signal from %d", entry.rank)
                     continue
                 check_proto(
-                    entry.cmd in ("start", "recover"),
+                    entry.cmd in (CMD_START, CMD_RECOVER),
                     f"unknown command {entry.cmd!r}",
                 )
                 if tree_map is None:
                     check_proto(
-                        entry.cmd == "start",
+                        entry.cmd == CMD_START,
                         f"{entry.cmd!r} before any worker started",
                     )
                     if entry.world_size > 0:
                         n_workers = entry.world_size
                         self.n_workers = n_workers
+                        # shard geometry follows (it is pinned at the
+                        # first lease; a resize AFTER leases started
+                        # would change micro-shard byte ranges under
+                        # live holders, so only the count updates here)
+                        self.shards.n_workers = n_workers
                     tree_map, parent_map, ring_map = get_link_map(n_workers)
                     todo_nodes = list(range(n_workers))
                     broker = _BrokerPool(
@@ -541,7 +586,7 @@ class RabitTracker:
                         entry.world_size in (-1, n_workers),
                         f"world_size {entry.world_size} != {n_workers}",
                     )
-                if entry.cmd == "recover":
+                if entry.cmd == CMD_RECOVER:
                     check_proto(
                         0 <= entry.rank < n_workers,
                         f"recover with invalid rank {entry.rank}",
@@ -640,13 +685,22 @@ class RabitTracker:
     def _finish_metrics_report(self) -> None:
         """End-of-job telemetry dump: the aggregated per-rank + cluster
         report is kept on ``self.metrics_report`` and, when
-        ``DMLC_METRICS_REPORT`` names a path, written there as JSON."""
-        if self.metrics.updates == 0:
+        ``DMLC_METRICS_REPORT`` names a path, written there as JSON.
+        A job that used the dynamic shard service gets its lease/steal
+        shape appended under ``"shards"``."""
+        shard_summary = (
+            self.shards.summary() if self.shards.n_shards is not None else None
+        )
+        if self.metrics.updates == 0 and shard_summary is None:
             return
         import json
 
         try:
-            self.metrics_report = self.metrics.report()
+            self.metrics_report = (
+                self.metrics.report() if self.metrics.updates else {}
+            )
+            if shard_summary is not None:
+                self.metrics_report["shards"] = shard_summary
         except Exception:
             # a failed report must never kill the state thread at the
             # finish line (heartbeat payloads are sanitized, but the
@@ -718,6 +772,10 @@ class RabitTracker:
         # the state thread blocks on its event queue, not on accept():
         # closing the socket alone no longer terminates it
         self._events.put(("stop", None, None, None))
+        # deregister the shard service (supervisor hook target) — but
+        # only if a newer tracker hasn't already replaced it
+        if _shardsvc.active_service() is self.shards:
+            _shardsvc.set_active(None)
 
 
 class PSTracker:
@@ -833,6 +891,24 @@ def submit(
                 err = abort_check()
                 if err is not None:
                     rabit.close()  # accept() raises; tracker thread exits
+                    if (
+                        isinstance(err, RendezvousNeverCompleted)
+                        and rabit.shards.all_complete()
+                    ):
+                        # the payload spoke the shard-lease protocol AND
+                        # every live ledger is fully accounted: a
+                        # dynamic-shard-only job has no rendezvous to
+                        # complete, so this is the clean finish, not the
+                        # not-a-dmlc-client wedge. Shard chatter alone
+                        # is not enough — workers that exited 0
+                        # mid-epoch (swallowed error) must keep the
+                        # verdict, not pass a partial epoch off as done
+                        logger.info(
+                            "job finished via the shard service without "
+                            "a rabit rendezvous: %s",
+                            rabit.shards.summary(),
+                        )
+                        break
                     raise err
         rabit.close()
     else:

@@ -146,6 +146,17 @@ def main() -> None:
     B = 2048
     SAVE_EVERY = 4  # batches between mid-epoch position checkpoints
     spec = BatchSpec(batch_size=B, layout="ell", max_nnz=K)
+    # DMLC_DYNAMIC_SHARDS=1: tracker-leased micro-shard placement
+    # (docs/sharding.md) — a straggling host drains fewer shards
+    # instead of gating the epoch. Needs the tracker rendezvous, and
+    # resume-by-position is static-only (mid-epoch resume under
+    # leasing is ledger-owned: completed micro-shards are simply not
+    # re-served), so the skip fast-forward and the per-rank position
+    # sidecars are skipped in this mode.
+    dynamic = (
+        os.environ.get("DMLC_DYNAMIC_SHARDS", "0") not in ("", "0")
+        and worker is not None
+    )
     # with a sidecar index, shards are count-exact and each epoch reads
     # in a fresh shuffled order (URI sugar → IndexedRecordIOSplitter);
     # without one, fall back to sequential byte-sharded reads
@@ -161,7 +172,11 @@ def main() -> None:
         uri = (
             f"{path}?index={path}.idx&shuffle=batch&batch_size={B}"
             f"&seed=1&epoch={epoch}"
-            + (f"&skip_records={skip}" if skip else "")
+            + (
+                "&dynamic_shards=1"
+                if dynamic
+                else (f"&skip_records={skip}" if skip else "")
+            )
             if has_index
             else path
         )
@@ -183,7 +198,7 @@ def main() -> None:
             # smaller shard's tail span, and a rank whose shard is
             # already exhausted resumes at its total = skip-everything).
             if (
-                has_index and gstep % SAVE_EVERY == 0
+                has_index and not dynamic and gstep % SAVE_EVERY == 0
                 and consumed % B == 0
             ):
                 ck.save_async(

@@ -983,6 +983,7 @@ class FakeTokenEndpointHandler(BaseHTTPRequestHandler):
 
 
 def test_gcs_service_account_jwt(tmp_path, monkeypatch):
+    pytest.importorskip("cryptography", reason="cryptography not installed")
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 

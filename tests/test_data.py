@@ -381,3 +381,122 @@ def test_default_parser_threads_tpu_host_policy(monkeypatch):
     assert default_parser_threads(2) == 5  # env wins
     monkeypatch.setenv("DMLC_PARSE_THREADS", "7")  # documented knob wins
     assert default_parser_threads(None) == 7
+
+
+def test_threaded_parser_bytes_read_is_delivery_watermark():
+    """ISSUE 10 satellite: bytes_read() must report bytes behind
+    DELIVERED batches, not race the producer thread mid-chunk. A base
+    parser whose counter jumps before its batch crosses the queue
+    exposes the over-report: after pulling batch k, the wrapper must
+    answer batch k's watermark exactly."""
+    import threading
+
+    from dmlc_core_tpu.data.parser import Parser, ThreadedParser
+
+    produced = threading.Semaphore(0)
+
+    class StepParser(Parser):
+        """Each parse_next 'consumes' 100 bytes and emits one block."""
+
+        def __init__(self):
+            self.n = 0
+
+        def parse_next(self):
+            if self.n >= 5:
+                return None
+            self.n += 1
+            produced.release()
+            return [make_block(2, seed=self.n)]
+
+        def before_first(self):
+            self.n = 0
+
+        def bytes_read(self):
+            return self.n * 100
+
+        def close(self):
+            pass
+
+    tp = ThreadedParser(StepParser(), max_capacity=8)
+    # let the producer run ahead: its own bytes_read() races to 500
+    # while nothing was delivered yet
+    for _ in range(5):
+        produced.acquire(timeout=5)
+    assert tp.bytes_read() == 0  # nothing delivered → nothing counted
+    seen = 0
+    while True:
+        blocks = tp.parse_next()
+        if blocks is None:
+            break
+        seen += 1
+        # exact watermark at every batch boundary, never ahead
+        assert tp.bytes_read() == seen * 100
+    assert seen == 5 and tp.bytes_read() == 500
+    # rewind resets the watermark with the stream
+    tp.before_first()
+    assert tp.bytes_read() == 0
+    assert tp.parse_next() is not None
+    assert tp.bytes_read() == 100
+    tp.close()
+
+
+def test_text_parser_close_waits_for_inflight_workers(tmp_path):
+    """ISSUE 10 satellite: close() must not tear the source down while
+    parse_block futures still run — cancel the pending, wait for the
+    running, THEN close the split."""
+    import threading
+    import time as _time
+
+    from dmlc_core_tpu.data.text_parser import TextParserBase
+    from dmlc_core_tpu.io import split as io_split
+
+    p = tmp_path / "t.txt"
+    p.write_text("".join(f"{i}\n" for i in range(20000)))
+
+    entered = threading.Event()
+    release = threading.Event()
+    closed_during_parse = []
+
+    class SlowParser(TextParserBase):
+        def parse_block(self, data):
+            entered.set()
+            release.wait(timeout=10)
+            # the source must still be open while this worker runs
+            closed_during_parse.append(self.source_closed())
+            return make_block(1)
+
+        def source_closed(self):
+            src = self.source
+            base = getattr(src, "_base", src)
+            return getattr(base, "_fs", None) is None and (
+                getattr(base, "offset_begin", 1)
+                < getattr(base, "offset_end", 0)
+            )
+
+    src = io_split.create(str(p), type="text", threaded=False)
+    tp = SlowParser(src, nthread=4)
+    if tp._pool is None:  # 1-cpu box: fan-out disabled, nothing to race
+        tp.close()
+        return
+
+    def pull():
+        try:
+            tp.parse_next()
+        except Exception:
+            # a PENDING slice cancelled by close() surfaces here as
+            # CancelledError — expected when closing under a live pull
+            pass
+
+    puller = threading.Thread(target=pull, daemon=True)
+    puller.start()
+    assert entered.wait(timeout=10)
+    closer = threading.Thread(target=tp.close, daemon=True)
+    closer.start()
+    _time.sleep(0.2)  # close() must now be BLOCKED on the running worker
+    assert closer.is_alive(), "close() returned with a worker in flight"
+    release.set()
+    closer.join(timeout=10)
+    assert not closer.is_alive()
+    puller.join(timeout=10)
+    # no RUNNING worker ever observed a closed source
+    assert closed_during_parse and not any(closed_during_parse)

@@ -365,6 +365,61 @@ def test_thread_pool_creation_quiet_outside_io_and_in_owners(tmp_path):
     ) == []
 
 
+def _tracker_findings(src, tmp_path, name="mod.py"):
+    """Findings for a file living under dmlc_core_tpu/tracker/ (the
+    L013 scope)."""
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(src)
+    return [(code, line) for (_, line, code, _) in lint.lint_file(f)]
+
+
+def test_rendezvous_cmd_literal_flagged_in_tracker(tmp_path):
+    """L013: the rendezvous command vocabulary is spelled out in
+    tracker/protocol.py's CMD_* constants only — a literal elsewhere in
+    tracker/ can typo into a silently-dropped unknown command."""
+    assert [c for c, _ in _tracker_findings(
+        'if cmd == "shutdown":\n    pass\n', tmp_path)] == ["L013"]
+    assert [c for c, _ in _tracker_findings(
+        'fs.send_str("shard_lease")\n', tmp_path)] == ["L013"]
+    assert [c for c, _ in _tracker_findings(
+        'x = cmd in ("start", "recover")\n', tmp_path)
+    ] == ["L013", "L013"]
+    # per-line opt-out works like every other rule
+    assert _tracker_findings(
+        'ok = cmd == "metrics"  # noqa: L013 (fixture)\n', tmp_path
+    ) == []
+
+
+def test_rendezvous_cmd_literal_quiet_outside_scope(tmp_path):
+    # tests/benches craft raw frames deliberately — out of scope
+    assert codes('fs.send_str("metrics")\n', tmp_path) == []
+    # elsewhere in the library too (the strings are only special on the
+    # rendezvous wire)
+    assert _lib_findings('mode = "print"\n', tmp_path) == []
+    # protocol.py owns the constants and is exempt
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True)
+    f = d / "protocol.py"
+    f.write_text('CMD_METRICS = "metrics"\nCMD_START = "start"\n')
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # non-command strings in tracker/ are not the rule's business
+    assert _tracker_findings('msg = "start listen on %s"\n', tmp_path) == []
+
+
+def test_rendezvous_cmd_set_matches_protocol():
+    """The lint's hardcoded vocabulary must track protocol.py's — a new
+    command added there without updating L013 would reopen the literal
+    loophole for exactly that command."""
+    sys.path.insert(0, str(REPO))
+    try:
+        from dmlc_core_tpu.tracker import protocol
+    finally:
+        sys.path.pop(0)
+    assert lint._L013_CMDS == protocol.RENDEZVOUS_CMDS
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

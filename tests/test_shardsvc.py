@@ -1,0 +1,774 @@
+"""Dynamic shard service (tracker/shardsvc.py + io/split.py
+DynamicShardSource, docs/sharding.md): ledger exactly-once semantics
+with a fake clock, the lease protocol over real tracker sockets, the
+worker driver's bit-identity with the static path, heartbeat-ridden
+lease renewal, and the chaos drill — a worker killed mid-lease under
+``fault://`` with supervisor relaunch, reclaimed micro-shards re-served
+exactly once, totals equal to a clean static run."""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+from dmlc_core_tpu.io.stream import FileStream
+from dmlc_core_tpu.tracker import shardsvc
+from dmlc_core_tpu.tracker.shardsvc import (
+    ShardLeaseClient,
+    ShardLedger,
+    ShardService,
+)
+from dmlc_core_tpu.tracker.supervisor import Supervisor
+from dmlc_core_tpu.tracker.tracker import RabitTracker
+from dmlc_core_tpu.utils.logging import Error
+
+N_ROWS = 3000
+
+
+@pytest.fixture
+def corpus(tmp_path):
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    with FileStream(rec, "w") as f, FileStream(idx, "w") as fi:
+        w = IndexedRecordIOWriter(f, fi)
+        for i in range(N_ROWS):
+            w.write_record(b"%06d|" % i + b"p" * 25, i)
+        w.flush_block()
+    return rec, idx
+
+
+@pytest.fixture
+def tracker(monkeypatch):
+    """A live tracker whose shard service the env points at."""
+    monkeypatch.setenv("DMLC_SHARD_OVERSPLIT", "4")
+    t = RabitTracker("127.0.0.1", 1)
+    t.start(1)
+    monkeypatch.setenv("DMLC_TRACKER_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_TRACKER_PORT", str(t.port))
+    monkeypatch.setenv("DMLC_TASK_ID", "0")
+    # a RabitWorker.start() elsewhere in this process binds the lease
+    # identity to ITS rendezvous rank — don't let it leak in here
+    monkeypatch.delenv("DMLC_SHARD_RANK", raising=False)
+    yield t
+    t.close()
+
+
+def drain_sha(split, gather=False, batch=512):
+    """(rows, sha256) of a split's full emission, in emission order."""
+    h = hashlib.sha256()
+    rows = 0
+    if gather:
+        while True:
+            g = split.next_gather_batch(batch)
+            if g is None:
+                break
+            buf, starts, sizes = g
+            flat = buf.reshape(-1) if buf.ndim > 1 else buf
+            for s, z in zip(starts.tolist(), sizes.tolist()):
+                h.update(flat[s : s + z].tobytes())
+            rows += len(starts)
+    else:
+        while True:
+            rec = split.next_record()
+            if rec is None:
+                break
+            h.update(rec)
+            rows += 1
+    return rows, h.hexdigest()
+
+
+# -- ledger unit (fake clock) --------------------------------------------------
+
+def test_ledger_grant_done_exactly_once():
+    led = ShardLedger(epoch=0, n_shards=4)
+    now = 100.0
+    leases = [led.grant(0, now, ttl=10.0) for _ in range(4)]
+    assert sorted(l.shard for l in leases) == [0, 1, 2, 3]
+    assert led.grant(0, now, ttl=10.0) is None  # everything leased
+    assert not led.complete()
+    for l in leases:
+        status, secs = led.record_done(l.shard, 0, now + 1.0)
+        assert status == "recorded" and secs == 1.0
+    assert led.complete()
+    assert led.record_done(2, 1, now + 2.0) == ("duplicate", None)
+    assert led.duplicates == 1
+
+
+def test_ledger_rejects_done_for_never_granted_shard():
+    # a done with no grant history (not leased, never reclaimed) is a
+    # client bug; accepting it would mark undrained data complete
+    led = ShardLedger(epoch=0, n_shards=4)
+    led.grant(0, 100.0, ttl=10.0)  # shard 0 leased, 1-3 still queued
+    with pytest.raises(ValueError, match="never granted"):
+        led.record_done(1, 0, 101.0)
+    assert not led.done and led.queue_depth() == 3
+
+
+def test_ledger_expiry_reclaim_and_steal():
+    led = ShardLedger(epoch=0, n_shards=2)
+    l0 = led.grant(0, 100.0, ttl=5.0)
+    led.grant(1, 100.0, ttl=5.0)
+    # rank 1 renews, rank 0 goes silent
+    assert led.renew_rank(1, 104.0, ttl=5.0) == 1
+    assert led.reclaim_expired(106.0) == [l0.shard]
+    assert led.reclaimed == 1
+    # the reclaimed shard is re-granted FIRST (queue front), to a
+    # different rank → stolen
+    l0b = led.grant(1, 106.0, ttl=5.0)
+    assert l0b.shard == l0.shard and led.stolen == 1
+    # the original (dead-slow but alive) holder finishes first: first
+    # completion wins, the thief's later done is the duplicate
+    assert led.record_done(l0.shard, 0, 107.0)[0] == "recorded"
+    assert led.record_done(l0.shard, 1, 108.0)[0] == "duplicate"
+
+
+def test_ledger_never_regrants_a_completed_shard():
+    """A reclaimed holder finishing LATE marks the shard done while
+    its queue entry survives — the next grant must discard it, never
+    hand a full lease on an already-committed shard (a thief would
+    re-emit every record, not just duplicate the accounting)."""
+    led = ShardLedger(epoch=0, n_shards=2)
+    l0 = led.grant(0, 100.0, ttl=5.0)
+    # rank 0 stalls past the TTL: shard back on the queue front
+    assert led.reclaim_expired(106.0) == [l0.shard]
+    assert led.queue_depth() == 2
+    # ...then finishes anyway (first finisher wins, shard still queued)
+    assert led.record_done(l0.shard, 0, 107.0)[0] == "recorded"
+    # the next two grants must be the OTHER shard, then nothing
+    l1 = led.grant(1, 107.0, ttl=5.0)
+    assert l1 is not None and l1.shard != l0.shard
+    assert led.grant(1, 107.0, ttl=5.0) is None
+    assert led.record_done(l1.shard, 1, 108.0)[0] == "recorded"
+    assert led.complete()
+
+
+def test_ledger_voluntary_release():
+    led = ShardLedger(epoch=0, n_shards=2)
+    l0 = led.grant(0, 100.0, ttl=30.0)
+    # only the holder can release; a stranger's release is a no-op
+    assert not led.release(l0.shard, rank=1)
+    assert led.release(l0.shard, rank=0)
+    assert led.queue_depth() == 2 and led.reclaimed == 1
+    # released = reclaimed semantics: re-grant to another rank = stolen
+    l0b = led.grant(1, 101.0, ttl=30.0)
+    assert l0b.shard == l0.shard and l0b.stolen
+    # a done shard can't be released back out of the ledger
+    assert led.record_done(l0.shard, 1, 102.0)[0] == "recorded"
+    assert not led.release(l0.shard, rank=1)
+
+
+def test_ledger_reclaim_rank_immediate():
+    led = ShardLedger(epoch=0, n_shards=4)
+    for _ in range(2):
+        led.grant(0, 100.0, ttl=30.0)
+    led.grant(1, 100.0, ttl=30.0)
+    shards = led.reclaim_rank(0)
+    assert len(shards) == 2 and led.queue_depth() == 1 + 2
+    # rank 1's lease untouched
+    assert len(led.leases) == 1
+
+
+def test_service_wait_then_done_and_renew_semantics():
+    clock = [1000.0]
+    svc = ShardService(n_workers=1, oversplit=2, ttl=8.0, clock=lambda: clock[0])
+    a = svc.lease(0, 0, None)
+    b = svc.lease(0, 0, None)
+    assert {a["status"], b["status"]} == {"lease"}
+    w = svc.lease(0, 0, None)
+    assert w["status"] == "wait" and 0.05 <= w["backoff"] <= 1.0
+    assert svc.renew(0, 0)["renewed"] == 2
+    assert svc.done(0, 0, a["shard"])["status"] == "recorded"
+    assert svc.done(0, 0, b["shard"])["epoch_complete"] is True
+    assert svc.lease(0, 0, None)["status"] == "done"
+    # a new epoch is a fresh ledger
+    assert svc.lease(0, 1, None)["status"] == "lease"
+    # renewing leases that already expired reports them lost
+    clock[0] += 100.0
+    assert svc.renew(0, 1)["status"] == "lost"
+
+
+def test_service_rejects_stale_dataset_done_after_switch():
+    """Epoch numbers restart at a dataset switch, so a straggler's
+    done/release from the OLD dataset carries shard numbers that land
+    on the NEW ledger — the fileset signature riding the request is
+    what keeps them off it (undrained validation data must never be
+    marked complete by a late train worker)."""
+    svc = ShardService(n_workers=1, oversplit=2, ttl=30.0)
+    a = svc.lease(0, 0, "train")
+    b = svc.lease(0, 0, "train")
+    assert svc.done(0, 0, a["shard"], "train")["status"] == "recorded"
+    assert svc.done(0, 0, b["shard"], "train")["status"] == "recorded"
+    # train drained: the next signature switches the dataset
+    v = svc.lease(0, 0, "val")
+    assert v["status"] == "lease"
+    # a train straggler's done/release for the val-leased shard: rejected
+    stale = svc.done(0, 0, v["shard"], "train")
+    assert stale["status"] == "error" and "dataset switch" in stale["error"]
+    rel = svc.release(0, 0, v["shard"], "train")
+    assert rel["status"] == "error"
+    assert svc._epochs[0].leases  # val lease untouched
+    # the val worker's own done (current signature) still lands
+    assert svc.done(0, 0, v["shard"], "val")["status"] == "recorded"
+
+
+def test_service_all_complete_gates_partial_epochs():
+    """all_complete() is submit's downgrade gate for shard-only jobs:
+    False before any work, False while a live ledger has undrained
+    shards (workers exiting 0 mid-epoch stay a loud verdict), True only
+    once every live ledger is fully accounted."""
+    svc = ShardService(n_workers=1, oversplit=2, ttl=30.0)
+    assert not svc.all_complete()  # no shard work happened at all
+    a = svc.lease(0, 0, None)
+    assert not svc.all_complete()  # partial epoch
+    assert svc.done(0, 0, a["shard"])["status"] == "recorded"
+    assert not svc.all_complete()  # one shard still queued
+    b = svc.lease(0, 0, None)
+    assert svc.done(0, 0, b["shard"])["status"] == "recorded"
+    assert svc.all_complete()
+
+
+def test_service_ledger_eviction_never_orphans_live_work():
+    """Two eviction holes: (a) an epoch BEHIND the live window must be
+    refused, not created-then-evicted in the same call (grant() would
+    hand out leases whose dones can never land); (b) advancing the
+    window must never evict a ledger with live leaseholders (their
+    renews/dones would hit a vanished ledger)."""
+    clock = [1000.0]
+    svc = ShardService(
+        n_workers=1, oversplit=1, ttl=30.0, clock=lambda: clock[0]
+    )
+    # fill the live window: epochs 1..keep_epochs, one live lease each
+    for ep in range(1, svc.keep_epochs + 1):
+        assert svc.lease(0, ep, None)["status"] == "lease"
+    # (a) behind the window: loud error, no orphaned grant
+    assert svc.lease(0, 0, None)["status"] == "error"
+    # (b) ahead of the window: evicting epoch 1 would strand its live
+    # leaseholder, so the newcomer's epoch is refused instead
+    assert svc.lease(1, svc.keep_epochs + 1, None)["status"] == "error"
+    # epoch 1's holder is untouched — its done still lands...
+    shard = next(iter(svc._epochs[1].leases))
+    assert svc.done(0, 1, shard)["status"] == "recorded"
+    # ...and with the oldest ledger complete the window advances again
+    assert svc.lease(1, svc.keep_epochs + 1, None)["status"] == "lease"
+
+
+def test_service_handle_is_unkillable():
+    svc = ShardService(n_workers=2, oversplit=1)
+    # negative rank = protocol placeholder, never a lease holder
+    assert json.loads(svc.handle("shard_lease", -1, "{}"))["status"] == "error"
+    assert json.loads(svc.handle("shard_lease", 0, "not json"))["status"] == (
+        "error"
+    )
+    assert json.loads(svc.handle("shard_done", 0, "{}"))["status"] == "error"
+    assert json.loads(svc.handle("shard_lease", 0, "[1,2]"))["status"] == (
+        "error"
+    )
+    ok = json.loads(svc.handle("shard_lease", 0, '{"epoch": 0}'))
+    assert ok["status"] == "lease" and ok["num_shards"] == 2
+    # a rank ABOVE n_workers joined mid-epoch: geometry is already
+    # pinned, the newcomer just drains the queue (elastic join,
+    # docs/sharding.md)
+    ok = json.loads(svc.handle("shard_lease", 7, '{"epoch": 0}'))
+    assert ok["status"] == "lease" and ok["num_shards"] == 2
+
+
+# -- wire protocol over a real tracker ----------------------------------------
+
+def test_lease_protocol_end_to_end(tracker):
+    c = ShardLeaseClient("127.0.0.1", tracker.port, rank=0)
+    seen = []
+    while True:
+        r = c.lease(0, fileset="sig")
+        if r["status"] != "lease":
+            break
+        seen.append(r["shard"])
+        assert r["num_shards"] == 4 and r["ttl"] > 0
+    assert sorted(seen) == [0, 1, 2, 3]
+    assert c.renew(0)["status"] == "ok"
+    for s in seen:
+        assert c.done(0, s)["status"] == "recorded"
+    assert c.lease(0, fileset="sig")["status"] == "done"
+    # every live ledger drained: a NEW signature is a sequential dataset
+    # switch (train → validation) — epochs and geometry start fresh
+    r = c.lease(0, fileset="other")
+    assert r["status"] == "lease"
+    # ...but with that lease outstanding the ledger is incomplete, so a
+    # third signature means concurrent different datasets: loud error
+    assert c.lease(0, fileset="third")["status"] == "error"
+    c.release(0, r["shard"])
+    # end-of-job report carries the shard shape
+    tracker.close()
+    tracker.join()
+    assert tracker.metrics_report is not None
+    assert tracker.metrics_report["shards"]["completed"] == 4
+
+
+def test_heartbeat_renews_leases(tracker):
+    c = ShardLeaseClient("127.0.0.1", tracker.port, rank=0)
+    r = c.lease(0)
+    assert r["status"] == "lease"
+    led = tracker.shards._epochs[0]
+    before = led.leases[r["shard"]].expires
+    time.sleep(0.05)
+    # a metrics heartbeat (NOT an explicit renew) must extend the lease
+    from dmlc_core_tpu.tracker.client import RabitWorker
+
+    w = RabitWorker("127.0.0.1", tracker.port)
+    w.rank = 0  # heartbeat() requires an assigned rank
+    w.heartbeat({"counters": {}, "gauges": {}, "histograms": {}})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if led.leases[r["shard"]].expires > before:
+            break
+        time.sleep(0.01)
+    assert led.leases[r["shard"]].expires > before
+
+
+# -- DynamicShardSource driver -------------------------------------------------
+
+@pytest.mark.parametrize("mode,gather", [("record", True), ("", False)])
+def test_dynamic_drain_bit_identical_to_static(tracker, corpus, mode, gather):
+    """Dynamic placement must not change shard content: a one-worker
+    dynamic drain (leases arrive in shard order 0..M-1) equals the
+    concatenation of static ``(i, M)`` drains bit-for-bit — shuffled
+    (per-shard (seed, epoch) permutation) AND sequential."""
+    rec, idx = corpus
+    q = f"?index={idx}&seed=5" + (f"&shuffle={mode}" if mode else "")
+    src = io_split.create(rec + q + "&dynamic_shards=1",
+                          type="recordio", threaded=False)
+    assert src.supports_gather() == gather
+    rows, sha = drain_sha(src, gather=gather)
+    M = src.num_shards
+    stats = src.io_stats()
+    src.close()
+    assert rows == N_ROWS
+    assert stats["leases"] == M and stats["shards_recorded"] == M
+    assert stats["mode"].startswith("dynamic:")
+    # static reference: the same M parts drained in order through the
+    # same emission path, hashed as one stream
+    h = hashlib.sha256()
+    total = 0
+    for i in range(M):
+        sp = io_split.create(rec + q, type="recordio", part_index=i,
+                             num_parts=M, threaded=False)
+        if gather:
+            while True:
+                g = sp.next_gather_batch(512)
+                if g is None:
+                    break
+                buf, starts, sizes = g
+                flat = buf.reshape(-1) if buf.ndim > 1 else buf
+                for s, z in zip(starts.tolist(), sizes.tolist()):
+                    h.update(flat[s : s + z].tobytes())
+                total += len(starts)
+        else:
+            while True:
+                r = sp.next_record()
+                if r is None:
+                    break
+                h.update(r)
+                total += 1
+        sp.close()
+    assert total == N_ROWS
+    assert h.hexdigest() == sha, "dynamic emission diverged from static"
+
+
+def test_dynamic_threaded_wraps_per_shard_readahead(tracker, corpus):
+    """``threaded=True`` (the default) gives each leased non-windowed
+    micro-shard the same ThreadedInputSplit a static drain would get,
+    and the drain stays bit-identical to the bare path."""
+    from dmlc_core_tpu.io.split import ThreadedInputSplit
+
+    rec, idx = corpus
+    uri = rec + f"?index={idx}&dynamic_shards=1"
+    src = io_split.create(uri, type="recordio", threaded=True)
+    # the probe (never read) must stay bare — an eager read-ahead
+    # thread on it would drain the whole set in the background
+    assert not isinstance(src._get_probe(), ThreadedInputSplit)
+    shard0 = src._make_splitter(0, 1, 0)
+    assert isinstance(shard0, ThreadedInputSplit)
+    shard0.close()
+    rows, sha = drain_sha(src)
+    src.close()
+    src2 = io_split.create(uri, type="recordio", threaded=False)
+    src2.epoch = 1  # fresh ledger; same content (no shuffle)
+    rows2, sha2 = drain_sha(src2)
+    src2.close()
+    assert rows == rows2 == N_ROWS and sha == sha2
+
+
+def test_two_workers_split_the_epoch_exactly_once(corpus, monkeypatch):
+    """Two concurrent drivers (distinct ranks) over one ledger: every
+    record exactly once across them, commits exactly-once per
+    micro-shard."""
+    rec, idx = corpus
+    monkeypatch.setenv("DMLC_SHARD_OVERSPLIT", "4")
+    t = RabitTracker("127.0.0.1", 2)
+    t.start(2)
+    results = {}
+    recorded = []
+    lock = threading.Lock()
+
+    def one(rank):
+        client = ShardLeaseClient("127.0.0.1", t.port, rank=rank)
+        src = io_split.DynamicShardSource(
+            make_splitter=lambda shard, M, ep: io_split.IndexedRecordIOSplitter(
+                rec, idx, shard, M, shuffle="record", seed=9, epoch=ep,
+            ),
+            client=client,
+            windowed_hint=True,
+        )
+
+        def on_done(shard, status):
+            with lock:
+                recorded.append((shard, status))
+
+        src.on_shard_done = on_done
+        rows, _sha = drain_sha(src, gather=True)
+        results[rank] = rows
+        src.close()
+
+    try:
+        threads = [threading.Thread(target=one, args=(r,)) for r in range(2)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        t.close()
+    assert sum(results.values()) == N_ROWS
+    statuses = [s for _, s in recorded]
+    assert statuses.count("recorded") == 8 == len(statuses)
+    assert sorted(s for s, _ in recorded) == list(range(8))
+
+
+def test_epoch_advance_and_fresh_ledger(tracker, corpus):
+    rec, idx = corpus
+    uri = f"{rec}?index={idx}&shuffle=record&seed=2&dynamic_shards=1"
+    src = io_split.create(uri, type="recordio", threaded=False)
+    r0, sha0 = drain_sha(src, gather=True)
+    src.before_first()
+    r1, sha1 = drain_sha(src, gather=True)
+    src.close()
+    assert r0 == r1 == N_ROWS
+    assert sha0 != sha1  # different epoch → different permutation
+    assert tracker.shards.summary()["completed"] == 8
+
+
+def test_create_sugar_and_guards(tracker, corpus):
+    rec, idx = corpus
+    # reset_partition is a static-placement concept
+    src = io_split.create(f"{rec}?index={idx}&dynamic_shards=1",
+                          type="recordio", threaded=False)
+    with pytest.raises(Error):
+        src.reset_partition(0, 2)
+    # whole-set introspection works without a lease
+    assert src.total_size() == os.path.getsize(rec)
+    src.close()
+    # skip_records needs static sharding
+    with pytest.raises(Error):
+        io_split.create(
+            f"{rec}?index={idx}&dynamic_shards=1&skip_records=8",
+            type="recordio", threaded=False,
+        )
+
+
+def test_close_releases_live_lease_immediately(tracker, corpus):
+    """close() with an unfinished shard hands the lease back via
+    cmd=shard_release — a peer leases it NOW, without waiting out a
+    TTL (which heartbeats could extend forever)."""
+    rec, idx = corpus
+    uri = f"{rec}?index={idx}&shuffle=record&seed=3&dynamic_shards=1"
+    src = io_split.create(uri, type="recordio", threaded=False)
+    assert src.next_record() is not None  # live lease on one shard
+    held = src.current_shard
+    src.close()
+    s = tracker.shards.summary()
+    assert s["reclaimed"] == 1 and s["queue_depth"] == s["n_shards"]
+    # a peer drains the whole epoch, including the released shard
+    peer = io_split.create(uri, type="recordio", threaded=False)
+    rows, _ = drain_sha(peer)
+    stats = peer.io_stats()
+    peer.close()
+    assert rows == N_ROWS and stats["shards_recorded"] == s["n_shards"]
+    assert held in range(s["n_shards"])
+
+
+def test_fileset_signature_normalizes_local_uri_forms(corpus, monkeypatch):
+    """file:///d/x.rec, /d/x.rec and a fault://-wrapped /d/x.rec are
+    the SAME dataset: their fileset signatures must agree or the chaos
+    topology (one wrapped worker among clean peers) gets the hard
+    'not reading the same dataset' error."""
+    rec, idx = corpus
+    seen = []
+
+    class _Probe:
+        def __init__(self):
+            self.rank = 0
+
+        def lease(self, epoch, fileset=None):
+            seen.append(fileset)
+            return {"status": "done"}
+
+    for form in (
+        f"{rec}?index={idx}&dynamic_shards=1",
+        f"file://{rec}?index={idx}&dynamic_shards=1",
+        f"fault://latency_ms=1,seed=5{rec}?index={idx}&dynamic_shards=1",
+    ):
+        monkeypatch.setenv("DMLC_TRACKER_URI", "127.0.0.1")
+        monkeypatch.setenv("DMLC_TRACKER_PORT", "1")  # never dialed
+        src = io_split.create(form, type="recordio", threaded=False)
+        src._client = _Probe()
+        assert src.next_record() is None  # probe answers done
+        src.close()
+    assert len(seen) == 3 and len(set(seen)) == 1, seen
+
+
+def test_create_without_tracker_fails_loudly(corpus, monkeypatch):
+    rec, idx = corpus
+    monkeypatch.delenv("DMLC_TRACKER_URI", raising=False)
+    monkeypatch.delenv("DMLC_TRACKER_PORT", raising=False)
+    with pytest.raises(Error, match="DMLC_TRACKER_URI"):
+        io_split.create(f"{rec}?index={idx}&dynamic_shards=1",
+                        type="recordio", threaded=False)
+
+
+def test_supervisor_hook_reclaims_leases(tracker):
+    c = ShardLeaseClient("127.0.0.1", tracker.port, rank=0)
+    assert c.lease(0)["status"] == "lease"
+    assert c.lease(0)["status"] == "lease"
+    # the supervisor's on_task_failure target resolves the live service
+    shardsvc.reclaim_task(0, "localhost")
+    assert tracker.shards.summary()["reclaimed"] == 2
+    assert tracker.shards.summary()["queue_depth"] == 4
+
+
+def test_reclaim_task_translates_task_id_to_rank(tracker):
+    """Rendezvous ranks are connect-order, not task ids: the tracker
+    feeds the translation at rank assignment, so a task-keyed
+    supervisor reclaim lands on the rank that holds the leases."""
+    # task "3" rendezvoused and was assigned rank 1; its leases are
+    # held by rank 1
+    tracker.shards.note_task_rank("3", 1)
+    c = ShardLeaseClient("127.0.0.1", tracker.port, rank=1)
+    assert c.lease(0)["status"] == "lease"
+    # a peer (task 0 == rank 0) holds its own lease — must survive
+    peer = ShardLeaseClient("127.0.0.1", tracker.port, rank=0)
+    assert peer.lease(0)["status"] == "lease"
+    shardsvc.reclaim_task(3, "localhost")
+    assert tracker.shards.summary()["reclaimed"] == 1
+    led = tracker.shards._epochs[0]
+    assert [l.rank for l in led.leases.values()] == [0]
+
+
+def test_lease_client_repins_rank_from_env(tracker, monkeypatch):
+    """A client constructed BEFORE RabitWorker.start() must not freeze
+    the pre-rendezvous task id: the defaulted rank is re-read at every
+    new lease, so the first lease after start() carries the rendezvous
+    rank the heartbeat renews by."""
+    monkeypatch.setenv("DMLC_TASK_ID", "0")
+    c = ShardLeaseClient("127.0.0.1", tracker.port)  # defaulted rank
+    assert c.rank == 0
+    monkeypatch.setenv("DMLC_SHARD_RANK", "5")  # start() ran
+    assert c.lease(0)["status"] == "lease"
+    assert c.rank == 5
+    led = tracker.shards._epochs[0]
+    assert [l.rank for l in led.leases.values()] == [5]
+    # an explicit rank never re-pins
+    c2 = ShardLeaseClient("127.0.0.1", tracker.port, rank=2)
+    assert c2.lease(0)["status"] == "lease"
+    assert c2.rank == 2
+
+
+def test_summary_counts_evicted_epochs():
+    """Whole-job accounting must survive the keep_epochs ledger cap:
+    counters from evicted ledgers fold into retired totals instead of
+    silently vanishing from the end-of-job report."""
+    clk = [100.0]
+    svc = ShardService(1, oversplit=2, ttl=30.0, clock=lambda: clk[0])
+    n_epochs = ShardService.keep_epochs + 4
+    for ep in range(n_epochs):
+        for _ in range(2):
+            resp = svc.lease(0, ep, None)
+            assert resp["status"] == "lease"
+            clk[0] += 1.0
+            assert svc.done(0, ep, resp["shard"])["status"] == "recorded"
+    s = svc.summary()
+    assert s["epochs_retired"] == 4
+    assert len(s["epochs"]) == ShardService.keep_epochs
+    assert s["granted"] == 2 * n_epochs
+    assert s["completed"] == 2 * n_epochs
+    assert s["reclaimed"] == 0 and s["duplicates"] == 0
+
+
+SHARD_ONLY_WORKER = """\
+import os, sys
+sys.path.insert(0, {repo!r})
+from dmlc_core_tpu.io import split as io_split
+
+src = io_split.create({uri!r}, type="recordio", threaded=False)
+n = 0
+while src.next_record() is not None:
+    n += 1
+src.close()
+print("drained", n, flush=True)
+"""
+
+
+def test_submit_shard_only_job_finishes_clean(corpus, tmp_path):
+    """A payload that speaks ONLY the shard-lease protocol (no rabit
+    rendezvous — the docs/sharding.md quick-start shape) must exit the
+    local backend cleanly: the anti-wedge heuristic's typed verdict
+    (RendezvousNeverCompleted) is downgraded to a clean finish when the
+    tracker's shard service did the job's accounting."""
+    rec, idx = corpus
+    script = tmp_path / "worker.py"
+    uri = f"{rec}?index={idx}&shuffle=record&seed=2&dynamic_shards=1"
+    script.write_text(SHARD_ONLY_WORKER.format(repo=REPO, uri=uri))
+    env = os.environ.copy()
+    env.update({
+        "PYTHONPATH": REPO,
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_RENDEZVOUS_GRACE": "1",
+        "DMLC_SHARD_OVERSPLIT": "2",
+    })
+    for k in ("DMLC_TRACKER_URI", "DMLC_TRACKER_PORT"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+         "--cluster", "local", "--num-workers", "2",
+         sys.executable, str(script)],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    drained = sum(
+        int(line.split()[-1])
+        for line in proc.stdout.splitlines()
+        if line.startswith("drained")
+    )
+    assert drained == N_ROWS
+    assert "finished via the shard service" in proc.stderr
+
+
+# -- chaos: kill a leaseholder mid-epoch --------------------------------------
+
+CHAOS_WORKER = """\
+import hashlib, json, os, sys
+sys.path.insert(0, {repo!r})
+from dmlc_core_tpu.io import split as io_split
+
+out = {out!r}
+task = os.environ["DMLC_TASK_ID"]
+attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+src = io_split.create({uri!r}, type="recordio", threaded=False)
+cur = {{}}
+
+def on_lease(shard, num_shards):
+    cur["shard"], cur["h"], cur["rows"] = shard, hashlib.sha256(), 0
+
+def on_done(shard, status):
+    # commit ONLY on the exactly-once ack: this is the accounting the
+    # ledger guarantees cluster-wide
+    if status == "recorded":
+        p = os.path.join(out, "shard_%d.json" % shard)
+        tmp = p + ".tmp%d" % os.getpid()
+        with open(tmp, "w") as f:
+            json.dump({{"rows": cur["rows"], "sha": cur["h"].hexdigest(),
+                       "task": task, "attempt": attempt}}, f)
+        os.replace(tmp, p)
+
+src.on_lease = on_lease
+src.on_shard_done = on_done
+n = 0
+while True:
+    rec = src.next_record()
+    if rec is None:
+        break
+    cur["h"].update(rec)
+    cur["rows"] += 1
+    n += 1
+    if task == "0" and attempt == 0 and n >= 37:
+        # die MID-LEASE: a partially drained micro-shard is in flight
+        os._exit(9)
+src.close()
+"""
+
+
+def test_chaos_kill_mid_lease_exactly_once(corpus, tmp_path, monkeypatch):
+    """The acceptance drill: 3 workers drain under ``fault://`` chaos,
+    one is killed mid-lease; the supervisor's failure hook reclaims its
+    lease, the relaunched worker (plus thieves) completes the epoch,
+    every micro-shard is committed EXACTLY once, and the committed
+    totals equal a clean static run shard-for-shard."""
+    rec, idx = corpus
+    monkeypatch.setenv("DMLC_SHARD_LEASE_TTL", "2.0")
+    monkeypatch.setenv("DMLC_SHARD_OVERSPLIT", "4")
+    tracker = RabitTracker("127.0.0.1", 3)
+    tracker.start(3)
+    out = tmp_path / "out"
+    out.mkdir()
+    # fault:// chaos on the data path: seeded resets healed by the
+    # retry layer while leases move around
+    uri = (
+        f"fault://resets=1,seed=11{rec}?index={idx}"
+        f"&shuffle=record&seed=4&dynamic_shards=1"
+    )
+    script = tmp_path / "worker.py"
+    script.write_text(CHAOS_WORKER.format(repo=REPO, out=str(out), uri=uri))
+
+    def launch(task_id, host, attempt):
+        env = os.environ.copy()
+        env.update({
+            "DMLC_TRACKER_URI": "127.0.0.1",
+            "DMLC_TRACKER_PORT": str(tracker.port),
+            "DMLC_TASK_ID": str(task_id),
+            "DMLC_NUM_ATTEMPT": str(attempt),
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen([sys.executable, str(script)], env=env)
+
+    sup = Supervisor(
+        launch, hosts=["localhost"], max_attempt=3,
+        host_fail_limit=float("inf"), relaunch_backoff=0.1,
+        on_task_failure=shardsvc.reclaim_task,
+    )
+    try:
+        sup.run(3)
+    finally:
+        summary = tracker.shards.summary()
+        tracker.close()
+    M = summary["n_shards"]
+    files = sorted(out.glob("shard_*.json"))
+    assert len(files) == M, f"committed {len(files)}/{M} micro-shards"
+    committed = {
+        int(f.name.split("_")[1].split(".")[0]): json.loads(f.read_text())
+        for f in files
+    }
+    # the victim held a lease when it died: reclaimed >= 1, and the
+    # epoch still completed exactly-once
+    assert sup.relaunches >= 1
+    assert summary["reclaimed"] >= 1
+    assert summary["completed"] == M
+    # clean static reference, shard for shard
+    total = 0
+    for i in range(M):
+        sp = io_split.create(
+            f"{rec}?index={idx}&shuffle=record&seed=4",
+            type="recordio", part_index=i, num_parts=M, threaded=False,
+        )
+        rows, sha = drain_sha(sp)
+        sp.close()
+        total += rows
+        assert committed[i]["rows"] == rows, f"shard {i} row count"
+        assert committed[i]["sha"] == sha, f"shard {i} bytes"
+    assert sum(c["rows"] for c in committed.values()) == total == N_ROWS

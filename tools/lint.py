@@ -63,6 +63,18 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  or like the file ({"traceEvents": ...});
                                  reading those keys from a loaded trace
                                  is fine.)
+  L013 rendezvous cmd string literal in dmlc_core_tpu/tracker/ (the
+                                 wire protocol's command strings —
+                                 start/recover/shutdown/print/metrics/
+                                 shard_lease/shard_renew/shard_done/
+                                 shard_release —
+                                 are spelled out in exactly one place:
+                                 tracker/protocol.py's CMD_* constants.
+                                 A literal elsewhere in tracker/ can
+                                 typo into an unknown-cmd drop the
+                                 protocol check never catches; compare
+                                 and send the constants. Tests crafting
+                                 raw frames live outside the scope.)
   L012 thread-pool creation in dmlc_core_tpu/io/ (exactly two pools are
                                  sanctioned: codec.py's decode pool —
                                  sized by the cgroup/affinity-aware
@@ -352,6 +364,44 @@ _L011_EXEMPT = ("/telemetry/tracing.py",)
 # pool owners: the codec decode pool and the span-fetch pool
 _L012_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
 _L012_EXEMPT = ("/io/codec.py", "/io/spanfetch.py")
+# L013 is scoped to dmlc_core_tpu/tracker/ and exempts the protocol
+# module, which owns the CMD_* constants. Kept in sync with
+# protocol.RENDEZVOUS_CMDS by a test (tests/test_lint.py).
+_L013_SCOPE_DIRS = ("dmlc_core_tpu/tracker/",)
+_L013_EXEMPT = ("/tracker/protocol.py",)
+_L013_CMDS = frozenset(
+    {
+        "start",
+        "recover",
+        "shutdown",
+        "print",
+        "metrics",
+        "shard_lease",
+        "shard_renew",
+        "shard_done",
+        "shard_release",
+    }
+)
+
+
+def _check_rendezvous_cmd_literals(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any string constant spelling a rendezvous command: inside
+    dmlc_core_tpu/tracker/ the wire command vocabulary lives in
+    protocol.py's ``CMD_*`` constants (single-site pattern of
+    L006/L008-L012) — a literal comparison or send elsewhere can typo
+    into a silently-dropped unknown command. Scoped in lint_file;
+    docstrings match only if the ENTIRE docstring is a command word,
+    which no real docstring is."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in _L013_CMDS
+        ):
+            yield node.lineno, (
+                f"rendezvous cmd literal {node.value!r} (compare/send the "
+                "CMD_* constants from tracker/protocol.py)"
+            )
 
 def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
     """Any import binding the ``socket`` module or
@@ -469,6 +519,7 @@ CHECKS = [
     ("L010", _check_shm_socket_imports),
     ("L011", _check_trace_event_literals),
     ("L012", _check_thread_pool_creation),
+    ("L013", _check_rendezvous_cmd_literals),
 ]
 
 
@@ -532,6 +583,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L012_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L012_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L013":
+            if posix.endswith(_L013_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L013_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L013_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
