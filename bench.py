@@ -800,6 +800,157 @@ def _dynamic_shard_bench() -> dict:
     }
 
 
+def _allreduce_sgd_main(out: str) -> None:
+    """Worker mode (``bench.py --allreduce-sgd out``): one rank of the
+    ``allreduce_recovery`` SGD job — per-step "gradients" summed across
+    ranks by the tracker-topology collective (tree path pinned: faulted
+    ring rounds retry over the tree, whose float fold order differs by
+    rounding, and the config asserts BIT equality), params checkpointed
+    in memory every SAVE_EVERY rounds, bootstrap-from-peer + replay on
+    relaunch (DMLC_NUM_ATTEMPT > 0). Host-side only: numpy, no jax.
+    Steps are paced (BENCH_ALLREDUCE_STEP_MS) so both the clean and the
+    chaos run are sleep-dominated and the makespan ratio measures
+    RECOVERY cost, not box weather."""
+    from dmlc_core_tpu.tracker.client import RabitWorker
+    from dmlc_core_tpu.tracker.collective import Collective
+
+    steps = int(os.environ.get("BENCH_ALLREDUCE_STEPS", "24"))
+    save_every = int(os.environ.get("BENCH_ALLREDUCE_SAVE_EVERY", "4"))
+    step_ms = float(os.environ.get("BENCH_ALLREDUCE_STEP_MS", "60"))
+    dim = int(os.environ.get("BENCH_ALLREDUCE_DIM", "65536"))
+
+    t0 = time.perf_counter()
+    w = RabitWorker()
+    rank = w.start()
+    world = w.world_size
+    c = Collective(w, io_timeout=120)
+    params = np.zeros(dim, dtype=np.float64)
+    step0 = 0
+    if int(os.environ.get("DMLC_NUM_ATTEMPT", "0") or 0) > 0:
+        version, state = c.load_checkpoint()
+        if state:
+            params = np.frombuffer(state, dtype=np.float64).copy()
+            step0 = int(version)
+    for s in range(step0, steps):
+        # deterministic per-(rank, step) gradient: replay after a
+        # bootstrap recomputes the identical contribution
+        g = np.sin(np.arange(dim) * (rank + 1) + s)
+        total = c.allreduce(g, "sum", path="tree")
+        params -= 0.01 * (total / world)
+        if (s + 1) % save_every == 0:
+            c.checkpoint(params.tobytes(), version=s + 1)
+        time.sleep(step_ms / 1000.0)
+    tmp = f"{out}.rank{rank}.tmp{os.getpid()}.npy"
+    np.save(tmp, params)
+    os.replace(tmp, f"{out}.rank{rank}.npy")
+    recoveries = c.recoveries
+    c.close()
+    w.shutdown()
+    print(json.dumps({
+        "rank": rank,
+        "secs": round(time.perf_counter() - t0, 3),
+        "recoveries": recoveries,
+    }))
+
+
+def _allreduce_recovery_bench() -> dict:
+    """The ``allreduce_recovery`` config (ISSUE 11 acceptance): a
+    3-worker allreduce-SGD job under a real Supervisor, run clean and
+    then with rank 2 SIGKILLed at the start of round 6 (a peer
+    checkpoint exists at round 4, so the relaunch exercises true
+    bootstrap-from-peer + replay through the survivors' result caches).
+    Invariants: the kill-and-recover job completes within 2x the
+    clean-run makespan AND every rank's final model is bit-identical to
+    the clean run's."""
+    import shutil
+    import tempfile
+
+    from dmlc_core_tpu.tracker import collective as _collective
+    from dmlc_core_tpu.tracker import shardsvc as _shardsvc
+    from dmlc_core_tpu.tracker.supervisor import Supervisor
+    from dmlc_core_tpu.tracker.tracker import RabitTracker
+
+    n_workers = 3
+    tmpdir = tempfile.mkdtemp(prefix="bench_allreduce_")
+
+    def run_drill(tag: str, faults: str) -> dict:
+        tracker = RabitTracker("127.0.0.1", n_workers)
+        tracker.start(n_workers)
+        out = os.path.join(tmpdir, f"model_{tag}")
+
+        def launch(task_id, host, attempt):
+            env = {
+                **os.environ,
+                "JAX_PLATFORMS": "cpu",
+                "DMLC_TRACKER_URI": "127.0.0.1",
+                "DMLC_TRACKER_PORT": str(tracker.port),
+                "DMLC_TASK_ID": str(task_id),
+                "DMLC_NUM_ATTEMPT": str(attempt),
+            }
+            env.pop("DMLC_COLLECTIVE_FAULTS", None)
+            if faults:
+                env["DMLC_COLLECTIVE_FAULTS"] = faults
+            return subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--allreduce-sgd", out],
+                env=env, stdout=subprocess.DEVNULL,
+            )
+
+        # exactly what backends/local.py registers: shard-lease reclaim
+        # and instant collective peer-death notification, coexisting on
+        # the observer list
+        sup = Supervisor(
+            launch, hosts=["localhost"], max_attempt=3,
+            host_fail_limit=float("inf"), relaunch_backoff=0.1,
+            on_task_failure=[
+                _shardsvc.reclaim_task,
+                _collective.notify_task_failure,
+            ],
+        )
+        t0 = time.perf_counter()
+        try:
+            sup.run(n_workers)
+        finally:
+            tracker.close()
+        makespan = time.perf_counter() - t0
+        models = [
+            np.load(f"{out}.rank{r}.npy") for r in range(n_workers)
+        ]
+        for r in range(1, n_workers):
+            assert np.array_equal(models[r], models[0]), (
+                f"{tag}: rank {r} final model differs from rank 0 — "
+                "allreduce did not converge ranks"
+            )
+        return {
+            "makespan_secs": round(makespan, 3),
+            "relaunches": sup.relaunches,
+            "model": models[0],
+        }
+
+    try:
+        clean = run_drill("clean", "")
+        chaos = run_drill(
+            "chaos", "kill_seq=6,kill_rank=2,kill_phase=start"
+        )
+        assert chaos["relaunches"] >= 1, (
+            "the injected SIGKILL never fired (no supervisor relaunch)"
+        )
+        identical = bool(np.array_equal(chaos["model"], clean["model"]))
+        return {
+            "clean_makespan_secs": clean["makespan_secs"],
+            "recovery_makespan_secs": chaos["makespan_secs"],
+            "relaunches": chaos["relaunches"],
+            "identical": identical,
+            "recovery_makespan_ratio": round(
+                chaos["makespan_secs"]
+                / max(clean["makespan_secs"], 1e-9),
+                2,
+            ),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def ensure_rec_index() -> None:
     """Index file for the bench .rec (uniform frame stride → arithmetic
     offsets; format = IndexedRecordIOWriter's ``key<TAB>offset``)."""
@@ -1512,6 +1663,18 @@ def main() -> None:
             # shard-service regression, never a capability skip
             dynamic_shards["failed"] = True
 
+    # worker-side collective under a mid-round SIGKILL (ISSUE 11
+    # acceptance): kill-and-recover SGD must finish within 2x the clean
+    # makespan with a bit-identical final model
+    try:
+        allreduce_recovery = _allreduce_recovery_bench()
+    except Exception as e:
+        allreduce_recovery = {"skipped": repr(e)}
+        if isinstance(e, (AssertionError, RuntimeError)):
+            # diverged ranks / a drill worker crashing is a collective
+            # regression, never a capability skip
+            allreduce_recovery["failed"] = True
+
     # flight-recorder attribution of this very run (ISSUE 8): snapshot
     # the rings BEFORE the overhead probe (its calibration loop wraps
     # the main thread's ring), then measure the recorder's cost — the
@@ -1611,6 +1774,26 @@ def main() -> None:
                 f"{dynamic_shards['straggler_speedup']}x static placement "
                 "(invariant >= 1.5x with one latency-degraded worker)"
             )
+    # allreduce_recovery invariant (ISSUE 11): a mid-round worker kill
+    # + supervisor relaunch + bootstrap-from-peer must land on the SAME
+    # final model as the clean run (bit-wise — tree path pinned) and
+    # complete within 2x the clean makespan
+    if allreduce_recovery.get("failed"):
+        failures.append(
+            f"allreduce_recovery: {allreduce_recovery['skipped']}"
+        )
+    if "skipped" not in allreduce_recovery:
+        if not allreduce_recovery["identical"]:
+            failures.append(
+                "allreduce_recovery: final model with injected kill + "
+                "relaunch != clean run (bit-wise, tree path)"
+            )
+        if not (allreduce_recovery["recovery_makespan_ratio"] <= 2.0):
+            failures.append(
+                f"allreduce_recovery: kill-and-recover makespan "
+                f"{allreduce_recovery['recovery_makespan_ratio']}x the "
+                "clean run (invariant <= 2x)"
+            )
 
     print(
         json.dumps(
@@ -1662,6 +1845,13 @@ def main() -> None:
                 "dynamic_shard_straggler": dynamic_shards,
                 "straggler_speedup": dynamic_shards.get(
                     "straggler_speedup"
+                ),
+                # worker-side collective under a mid-round SIGKILL
+                # (ISSUE 11): kill-and-recover within 2x the clean
+                # makespan, final model bit-identical
+                "allreduce_recovery": allreduce_recovery,
+                "recovery_makespan_ratio": allreduce_recovery.get(
+                    "recovery_makespan_ratio"
                 ),
                 **_codec_summary(),
                 # gather/legacy speedup is THE tentpole acceptance
@@ -1774,5 +1964,9 @@ if __name__ == "__main__":
         # worker mode: host-side drain of this worker's (static or
         # leased) micro-shards, no jax, no data generation
         _dynamic_shard_drain_main(sys.argv[2], sys.argv[3], sys.argv[4])
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--allreduce-sgd":
+        # worker mode: one rank of the allreduce_recovery SGD drill,
+        # numpy-only, no data generation
+        _allreduce_sgd_main(sys.argv[2])
     else:
         main()

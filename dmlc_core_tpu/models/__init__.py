@@ -8,7 +8,13 @@ regression, the classic rabit/ps-lite workload) is what __graft_entry__ and
 bench.py exercise.
 """
 
+from .common import sgd_update
 from .fm import FactorizationMachine
 from .linear import LinearRegression, LogisticRegression
 
-__all__ = ["LinearRegression", "LogisticRegression", "FactorizationMachine"]
+__all__ = [
+    "LinearRegression",
+    "LogisticRegression",
+    "FactorizationMachine",
+    "sgd_update",
+]

@@ -63,8 +63,16 @@ class FactorizationMachine:
             )
         return data_loss
 
+    def loss_and_grads(
+        self, params: Params, batch: Batch
+    ) -> Tuple[jax.Array, Params]:
+        """(loss, grads) without the update — see
+        ``linear._LinearBase.loss_and_grads``: the half step a
+        multi-host SGD loop allreduces before one shared update."""
+        return jax.value_and_grad(self.loss)(params, batch)
+
     def sgd_step(
         self, params: Params, batch: Batch, lr: float = 0.05
     ) -> Tuple[Params, jax.Array]:
-        loss_val, grads = jax.value_and_grad(self.loss)(params, batch)
+        loss_val, grads = self.loss_and_grads(params, batch)
         return sgd_update(params, grads, lr), loss_val

@@ -56,12 +56,22 @@ class _LinearBase:
             data_loss = data_loss + self.l2 * jnp.sum(params["w"] ** 2)
         return data_loss
 
+    def loss_and_grads(
+        self, params: Params, batch: Batch
+    ) -> Tuple[jax.Array, Params]:
+        """(loss, grads) WITHOUT the update — the distributed-SGD half
+        step: a multi-host loop computes grads per rank, allreduces
+        them over the tracker collective (tracker/collective.py), then
+        applies one shared ``sgd_update`` so every rank steps to the
+        identical params (examples/train_criteo_rec.py)."""
+        return jax.value_and_grad(self.loss)(params, batch)
+
     def sgd_step(
         self, params: Params, batch: Batch, lr: float = 0.1
     ) -> Tuple[Params, jax.Array]:
         """One SGD step; jit this (or wrap with parallel.data_parallel_step
         for SPMD over a mesh)."""
-        loss_val, grads = jax.value_and_grad(self.loss)(params, batch)
+        loss_val, grads = self.loss_and_grads(params, batch)
         return sgd_update(params, grads, lr), loss_val
 
 

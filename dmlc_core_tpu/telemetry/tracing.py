@@ -597,6 +597,9 @@ _WAIT_STAGES = frozenset(
         "fetch_wait",         # window loader starved by remote span reads
         "shard_lease_wait",   # dynamic-shard worker idle: every micro-shard
                               # is leased out (or the tracker is slow)
+        "allreduce_wait",     # collective round blocked on peer links —
+                              # a straggling/dead peer, or recovery in
+                              # flight (tracker/collective.py)
         "slot_wait",
     }
 )

@@ -22,7 +22,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
-from .. import shardsvc
+from .. import collective, shardsvc
 from ..supervisor import Supervisor, default_max_attempt
 from . import run_tracker_submit
 
@@ -123,10 +123,15 @@ def submit(args) -> None:
             hosts=["localhost"],
             max_attempt=default_max_attempt(args.local_num_attempt + 1),
             host_fail_limit=float("inf"),
-            # a dead worker's shard leases go back to the queue NOW
-            # (no-op when the job never leased — shardsvc resolves the
-            # live service lazily, so static jobs pay nothing)
-            on_task_failure=shardsvc.reclaim_task,
+            # a dead worker's shard leases go back to the queue NOW and
+            # its collective peers learn of the death NOW (both no-ops
+            # when the job never leased / never opened a watch — each
+            # hook resolves its live service lazily, so static and
+            # non-collective jobs pay nothing)
+            on_task_failure=[
+                shardsvc.reclaim_task,
+                collective.notify_task_failure,
+            ],
         )
         # the tasks-exited-but-rendezvous-never-completed heuristic only
         # holds on the rabit path; the PS tracker joins a scheduler

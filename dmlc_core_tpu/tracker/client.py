@@ -19,6 +19,7 @@ import socket
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..io.retry import _env_float
 from .protocol import (
     CMD_METRICS,
     CMD_PRINT,
@@ -26,14 +27,23 @@ from .protocol import (
     CMD_SHUTDOWN,
     CMD_START,
     FramedSocket,
+    connect_peer,
     connect_worker,
+    make_listener,
 )
 
 __all__ = ["RabitWorker"]
 
 
 class RabitWorker:
-    """One worker's view of the rendezvous."""
+    """One worker's view of the rendezvous.
+
+    Peer links get explicit timeouts: dials and the incoming-link
+    identify recv are capped by ``DMLC_PEER_CONNECT_TIMEOUT`` (30 s
+    default) so a half-dead peer can never wedge the wiring, and wired
+    links are handed over in blocking mode (consumers — the collective
+    engine — manage their own IO deadlines). ``shutdown()``/``close()``
+    are idempotent."""
 
     def __init__(
         self,
@@ -60,6 +70,8 @@ class RabitWorker:
         self.ring_next = -1
         self.links: Dict[int, socket.socket] = {}
         self._listener: Optional[socket.socket] = None
+        self.connect_timeout = _env_float("DMLC_PEER_CONNECT_TIMEOUT", 30.0)
+        self._shut = False
 
     # -- tracker connection helpers -----------------------------------------
     def _connect_tracker(self, cmd: str, rank: int, world: int) -> FramedSocket:
@@ -73,10 +85,16 @@ class RabitWorker:
 
         ``recover_rank`` >= 0 re-registers after a restart (cmd=recover),
         reclaiming the previous rank (reference tracker.py:290-292).
+        Re-entrant: a survivor re-joining after a peer death calls
+        ``start(recover_rank=self.rank)`` with its live links intact —
+        only the missing ones are re-brokered (rabit recover contract).
         """
-        self._listener = socket.socket()
-        self._listener.bind(("", 0))
-        self._listener.listen(16)
+        if self._listener is not None:
+            # re-entry (recover / retry after a failed start): the old
+            # accept socket is stale — peers are told the NEW port
+            self._listener.close()
+        self._listener = make_listener("", 0)
+        self._shut = False
         my_port = self._listener.getsockname()[1]
 
         cmd = CMD_RECOVER if recover_rank >= 0 else CMD_START
@@ -121,8 +139,13 @@ class RabitWorker:
             n_err = 0
             for host, port, peer_rank in to_connect:
                 try:
-                    peer = socket.create_connection((host, port), timeout=30)
-                    FramedSocket(peer).send_int(self.rank)
+                    # the dial AND the identifying send ride one explicit
+                    # deadline ($DMLC_PEER_CONNECT_TIMEOUT): a half-dead
+                    # peer fails this round of brokering instead of
+                    # wedging it (the tracker re-enters on n_err != 0)
+                    peer = connect_peer(
+                        host, port, self.rank, timeout=self.connect_timeout
+                    )
                     self.links[peer_rank] = peer
                 except OSError:
                     n_err += 1
@@ -214,18 +237,27 @@ class RabitWorker:
         fs.close()
 
     def shutdown(self) -> None:
-        """Signal completion (cmd=shutdown, reference tracker.py:272-277)."""
+        """Signal completion (cmd=shutdown, reference tracker.py:272-277).
+        Idempotent: a second call is a no-op — the tracker treats a
+        duplicate shutdown from the same rank as a protocol violation,
+        so teardown paths that race (atexit + explicit close) must not
+        double-send it."""
+        if self._shut:
+            return
+        self._shut = True
         fs = self._connect_tracker(CMD_SHUTDOWN, self.rank, -1)
         fs.close()
         self.close()
 
     def close(self) -> None:
-        for s in self.links.values():
+        """Close peer links + the accept socket. Idempotent (close after
+        shutdown, or close twice, is a no-op)."""
+        links, self.links = self.links, {}
+        for s in links.values():
             try:
                 s.close()
             except OSError:
                 pass
-        self.links.clear()
         if self._listener is not None:
             self._listener.close()
             self._listener = None

@@ -14,9 +14,11 @@ shard service commands ``CMD_SHARD_LEASE``/``CMD_SHARD_RENEW``/
 ``CMD_SHARD_DONE``/``CMD_SHARD_RELEASE`` (docs/sharding.md): each
 carries ONE length-prefixed
 JSON request and receives ONE length-prefixed JSON response on the same
-connection. Purely additive: a reference tracker that never sees these
-commands is unaffected, and every payload reuses the existing string
-framing (MAX_STR bounds it).
+connection, and ``CMD_WATCH`` (docs/collectives.md): a persistent
+worker connection the tracker pushes peer-death notices down (one JSON
+string frame per supervisor-reported task failure). Purely additive: a
+reference tracker that never sees these commands is unaffected, and
+every payload reuses the existing string framing (MAX_STR bounds it).
 
 This module is the ONLY place command strings are spelled out (lint
 L013): every other module compares/sends the ``CMD_*`` constants, so a
@@ -44,6 +46,12 @@ CMD_SHARD_LEASE = "shard_lease"
 CMD_SHARD_RENEW = "shard_renew"
 CMD_SHARD_DONE = "shard_done"
 CMD_SHARD_RELEASE = "shard_release"
+#: collective peer-death watch (tracker/collective.py): the connection
+#: STAYS OPEN — the tracker pushes one JSON line per task failure the
+#: supervisor reports, so a surviving worker learns a peer died the
+#: instant the supervisor does (observer hook), not when a link
+#: timeout fires
+CMD_WATCH = "watch"
 
 #: commands answered by the shard service with ONE JSON response frame
 SHARD_CMDS = frozenset(
@@ -53,7 +61,7 @@ SHARD_CMDS = frozenset(
 #: every command the tracker understands (lint L013 bans spelling these
 #: strings outside this module)
 RENDEZVOUS_CMDS = frozenset(
-    {CMD_START, CMD_RECOVER, CMD_SHUTDOWN, CMD_PRINT, CMD_METRICS}
+    {CMD_START, CMD_RECOVER, CMD_SHUTDOWN, CMD_PRINT, CMD_METRICS, CMD_WATCH}
 ) | SHARD_CMDS
 
 __all__ = [
@@ -66,11 +74,16 @@ __all__ = [
     "CMD_SHARD_RENEW",
     "CMD_SHARD_DONE",
     "CMD_SHARD_RELEASE",
+    "CMD_WATCH",
     "SHARD_CMDS",
     "RENDEZVOUS_CMDS",
     "MAGIC",
     "FramedSocket",
     "connect_worker",
+    "connect_peer",
+    "make_listener",
+    "bind_first_free",
+    "find_free_port",
 ]
 
 
@@ -117,6 +130,78 @@ class FramedSocket:
             self.sock.close()
         except OSError:
             pass
+
+
+def make_listener(
+    host: str = "", port: int = 0, backlog: int = 16
+) -> socket.socket:
+    """Bound+listening TCP socket. One of the sanctioned socket
+    construction sites (lint L014): every listener in tracker/ — the
+    worker's peer-link accept socket, test fakes — is built here so
+    socket options and error handling cannot drift per call site."""
+    sock = socket.socket()
+    try:
+        sock.bind((host, port))
+        sock.listen(backlog)
+    except BaseException:
+        sock.close()
+        raise
+    return sock
+
+
+def bind_first_free(
+    host_ip: str, port: int, port_end: int, backlog: int = 256
+) -> "tuple[socket.socket, int]":
+    """Listener bound to the first free port in ``[port, port_end)``
+    for ``host_ip``'s address family (the tracker's reference port-scan
+    bind, tracker.py:144-149). Raises ``OSError`` when the whole range
+    is taken."""
+    family = socket.getaddrinfo(host_ip, None)[0][0]
+    sock = socket.socket(family, socket.SOCK_STREAM)
+    for p in range(port, port_end):
+        try:
+            sock.bind((host_ip, p))
+            sock.listen(backlog)
+            return sock, p
+        except OSError as e:
+            if e.errno in (98, 48):  # EADDRINUSE (linux, mac)
+                continue
+            sock.close()
+            raise
+    sock.close()
+    raise OSError(f"no free tracker port in [{port},{port_end})")
+
+
+def find_free_port(host_ip: str, port: int, port_end: int):
+    """First bindable port in ``[port, port_end)`` (probe-and-release —
+    the PSTracker root-port pick), or ``None`` when the range is full."""
+    family = socket.getaddrinfo(host_ip, None)[0][0]
+    for p in range(port, port_end):
+        with socket.socket(family, socket.SOCK_STREAM) as probe:
+            try:
+                probe.bind(("", p))
+                return p
+            except OSError:
+                continue
+    return None
+
+
+def connect_peer(
+    host: str, port: int, my_rank: int, timeout: float = 30.0
+) -> socket.socket:
+    """Dial a peer worker's accept socket and identify (one int32: our
+    rank — the frame ``RabitWorker._await_peer_links`` reads). The dial
+    AND the identifying send share ``timeout``; the wired socket is
+    returned in BLOCKING mode (link consumers — the collective engine —
+    set their own IO deadlines per operation)."""
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        FramedSocket(sock).send_int(my_rank)
+        sock.settimeout(None)
+        return sock
+    except BaseException:
+        sock.close()
+        raise
 
 
 def connect_worker(

@@ -40,7 +40,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 __all__ = [
     "Supervisor",
@@ -109,7 +109,11 @@ class Supervisor:
         relaunch_backoff: Optional[float] = None,
         backoff_cap: float = 30.0,
         quarantine_secs: Optional[float] = None,
-        on_task_failure: Optional[Callable[[int, str], None]] = None,
+        on_task_failure: Union[
+            Callable[[int, str], None],
+            Sequence[Callable[[int, str], None]],
+            None,
+        ] = None,
     ) -> None:
         self.launch = launch
         self.hosts = list(hosts)
@@ -142,13 +146,22 @@ class Supervisor:
             if quarantine_secs is not None
             else _env_secs("DMLC_HOST_QUARANTINE", 5.0)
         )
-        # failure observer ``(task_id, host)``, called BEFORE the
+        # failure observers ``(task_id, host)``, each called BEFORE the
         # relaunch is scheduled: the dynamic shard service hangs its
-        # lease-reclaim here (tracker/shardsvc.reclaim_task) so a dead
-        # worker's micro-shards re-enter the queue immediately instead
-        # of waiting out the lease TTL. Must not raise; exceptions are
-        # swallowed (the relaunch path cannot ride on an observer).
-        self.on_task_failure = on_task_failure
+        # lease-reclaim here (tracker/shardsvc.reclaim_task) and the
+        # collective engine its instant peer-death notification
+        # (tracker/collective.notify_task_failure) — a LIST, not
+        # last-writer-wins, so the two coexist. Accepts one callable or
+        # a sequence; ``add_on_task_failure`` appends later. Observers
+        # must not raise; exceptions are swallowed per observer (the
+        # relaunch path — and the other observers — cannot ride on one).
+        if on_task_failure is None:
+            observers: List[Callable[[int, str], None]] = []
+        elif callable(on_task_failure):
+            observers = [on_task_failure]
+        else:
+            observers = list(on_task_failure)
+        self.on_task_failure = observers
         self.failures: Dict[int, int] = {}  # task_id -> failed runs
         self.host_failures: Dict[str, int] = {}
         self.blacklist: set = set()
@@ -157,6 +170,13 @@ class Supervisor:
         self.relaunches = 0
         self.backoffs: List[float] = []  # scheduled relaunch delays
         self.error: Optional[BaseException] = None
+
+    def add_on_task_failure(
+        self, observer: Callable[[int, str], None]
+    ) -> None:
+        """Append a failure observer (``(task_id, host)``); every
+        registered observer fires per failure, in registration order."""
+        self.on_task_failure.append(observer)
 
     # -- placement -----------------------------------------------------------
     def _healthy_hosts(self) -> List[str]:
@@ -209,9 +229,9 @@ class Supervisor:
         crash-looping task cannot hammer the cluster at poll speed."""
         self.failures[r.task_id] = self.failures.get(r.task_id, 0) + 1
         self.host_failures[r.host] = self.host_failures.get(r.host, 0) + 1
-        if self.on_task_failure is not None:
+        for observer in self.on_task_failure:
             try:
-                self.on_task_failure(r.task_id, r.host)
+                observer(r.task_id, r.host)
             except Exception:
                 logger.exception("on_task_failure observer failed")
         self._quarantine(r.host)

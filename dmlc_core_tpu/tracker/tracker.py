@@ -22,6 +22,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..telemetry import ClusterAggregator, serve_metrics
 from ..telemetry import tracing as _tracing
+from . import collective as _collective
 from . import shardsvc as _shardsvc
 from .protocol import (
     CMD_METRICS,
@@ -29,9 +30,12 @@ from .protocol import (
     CMD_RECOVER,
     CMD_SHUTDOWN,
     CMD_START,
+    CMD_WATCH,
     MAGIC,
     SHARD_CMDS,
     FramedSocket,
+    bind_first_free,
+    find_free_port,
 )
 from .supervisor import RendezvousNeverCompleted
 from .topology import get_link_map
@@ -60,7 +64,7 @@ def get_host_ip(host_ip: Optional[str] = None) -> str:
         except socket.gaierror:
             ip = socket.gethostbyname(socket.gethostname())
         if ip.startswith("127."):
-            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:  # noqa: L014 (UDP route probe, not a rendezvous/data socket)
                 probe.connect(("10.255.255.255", 1))
                 ip = probe.getsockname()[0]
         return ip
@@ -312,22 +316,7 @@ class RabitTracker:
         #: a client that *completes* frames can still lie about identity;
         #: the tracker only defends liveness + state consistency.
         self.client_timeout = client_timeout
-        family = socket.getaddrinfo(host_ip, None)[0][0]
-        sock = socket.socket(family, socket.SOCK_STREAM)
-        bound = None
-        for p in range(port, port_end):
-            try:
-                sock.bind((host_ip, p))
-                bound = p
-                break
-            except OSError as e:
-                if e.errno in (98, 48):  # EADDRINUSE (linux, mac)
-                    continue
-                raise
-        if bound is None:
-            sock.close()
-            raise OSError(f"no free tracker port in [{port},{port_end})")
-        sock.listen(256)
+        sock, bound = bind_first_free(host_ip, port, port_end)
         self.sock = sock
         self.host_ip = host_ip
         self.port = bound
@@ -353,6 +342,14 @@ class RabitTracker:
         # failure hook can reclaim a dead task's leases immediately.
         self.shards = _shardsvc.ShardService(n_workers)
         _shardsvc.set_active(self.shards)
+        # collective peer-death watch (collective.py, docs/collectives.md):
+        # workers holding a cmd=watch connection learn of a supervisor-
+        # reported task failure the instant the supervisor does.
+        # Registered process-globally like the shard service, so the
+        # supervisor's on_task_failure observer list can name
+        # collective.notify_task_failure without tracker wiring.
+        self.watch = _collective.DeathWatch()
+        _collective.set_active_watch(self.watch)
         logger.info("start listen on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
@@ -398,6 +395,21 @@ class RabitTracker:
                 )
                 entry.sock.send_str(resp)
                 entry.sock.close()
+                return
+            if entry.cmd == CMD_WATCH:
+                # collective death watch: the connection STAYS OPEN and
+                # is push-only from here on (DeathWatch sends one JSON
+                # string frame per supervisor-reported task failure), so
+                # it never touches the state thread. A fabricated rank
+                # is dropped — it could otherwise evict a live watcher.
+                if not 0 <= entry.rank < self.n_workers:
+                    logger.warning(
+                        "watch registration from invalid rank %d — "
+                        "dropping connection", entry.rank,
+                    )
+                    entry.sock.close()
+                    return
+                self.watch.add(entry.rank, entry.sock)
                 return
         except (ConnectionError, OSError) as e:
             logger.warning("bad handshake: %s", e)
@@ -491,8 +503,10 @@ class RabitTracker:
                 if entry.jobid != "NULL":
                     job_map[entry.jobid] = rank_done
                     # supervisor reclaim is task-keyed; leases are held
-                    # by rendezvous rank — record the translation
+                    # by rendezvous rank — record the translation (the
+                    # death watch pushes rank-keyed notices the same way)
                     self.shards.note_task_rank(entry.jobid, rank_done)
+                    self.watch.note_task_rank(entry.jobid, rank_done)
                 logger.debug(
                     "%s from %s; assigned rank %d",
                     entry.cmd, entry.host, rank_done,
@@ -772,10 +786,14 @@ class RabitTracker:
         # the state thread blocks on its event queue, not on accept():
         # closing the socket alone no longer terminates it
         self._events.put(("stop", None, None, None))
-        # deregister the shard service (supervisor hook target) — but
-        # only if a newer tracker hasn't already replaced it
+        # deregister the shard service and the death watch (supervisor
+        # hook targets) — but only if a newer tracker hasn't already
+        # replaced them
         if _shardsvc.active_service() is self.shards:
             _shardsvc.set_active(None)
+        if _collective.active_watch() is self.watch:
+            _collective.set_active_watch(None)
+        self.watch.close()
 
 
 class PSTracker:
@@ -797,16 +815,7 @@ class PSTracker:
         if cmd is None:
             return
         self.host_ip = host_ip
-        family = socket.getaddrinfo(host_ip, None)[0][0]
-        self.port = None
-        for p in range(port, port_end):
-            with socket.socket(family, socket.SOCK_STREAM) as probe:
-                try:
-                    probe.bind(("", p))
-                    self.port = p
-                    break
-                except OSError:
-                    continue
+        self.port = find_free_port(host_ip, port, port_end)
         assert self.port is not None, "no free PS root port"
         env = os.environ.copy()
         env["DMLC_ROLE"] = "scheduler"

@@ -1,0 +1,668 @@
+"""Worker-side collective engine (tracker/collective.py): allreduce /
+broadcast over the tracker topology with rabit-parity fault tolerance.
+
+Layers covered, cheapest first:
+- ``reference_allreduce`` self-consistency (the bit-identity oracle);
+- real-socket jobs (threads + one RabitTracker, the test_tracker.py
+  pattern): tree AND ring paths bit-identical to the reference for
+  sum/max/min across 2-8 ranks, broadcast from every root, barrier,
+  custom reducers, dtype coverage, uneven ring segments;
+- fault tolerance in-process: seeded mid-round link resets healed by
+  reset-flood + re-rendezvous, a dead worker re-joining via the jobid
+  memo, bootstrap-from-peer ``load_checkpoint``, multi-round replay
+  through the survivors' result caches, instant peer-death notification
+  via the tracker DeathWatch (no timeout discovery);
+- the chaos drill: a REAL 3-process SGD job under the supervisor,
+  one worker SIGKILLed mid-round by the fault injector, relaunched,
+  bootstrapped from a peer — final model bit-identical to a clean run.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.tracker import collective as coll_mod
+from dmlc_core_tpu.tracker.client import RabitWorker
+from dmlc_core_tpu.tracker.collective import (
+    Collective,
+    DeathWatch,
+    active_watch,
+    notify_task_failure,
+    reference_allreduce,
+    set_active_watch,
+)
+from dmlc_core_tpu.tracker.protocol import FramedSocket
+from dmlc_core_tpu.tracker.supervisor import Supervisor
+from dmlc_core_tpu.tracker.tracker import RabitTracker
+from dmlc_core_tpu.utils.logging import Error
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- harness -----------------------------------------------------------------
+
+
+def run_collective(n, body, io_timeout=30.0, **coll_kw):
+    """One real-socket job: a tracker plus ``n`` threaded workers, each
+    running ``body(coll, rank) -> result`` over a wired Collective.
+    Returns results indexed by rank; raises on any worker error."""
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+    results = [None] * n
+    errors = []
+
+    def one(i):
+        try:
+            w = RabitWorker("127.0.0.1", tracker.port, jobid=str(i))
+            rank = w.start(world_size=n if i == 0 else -1)
+            c = Collective(w, io_timeout=io_timeout, **coll_kw)
+            try:
+                results[rank] = body(c, rank)
+            finally:
+                c.close(linger=0.2)
+                w.shutdown()
+        except Exception as e:  # noqa: BLE001 - surfaced via errors
+            import traceback
+
+            traceback.print_exc()
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    alive = [t for t in threads if t.is_alive()]
+    try:
+        assert not errors, errors
+        assert not alive, f"{len(alive)} worker thread(s) wedged"
+    finally:
+        tracker.join()
+        tracker.close()
+    return results
+
+
+def _inputs(n, dtype, size=37, signed=True):
+    """Per-rank deterministic arrays; size 37 is coprime with every
+    tested world size, so ring segments are uneven."""
+    out = []
+    rng = np.random.default_rng(1234)
+    for r in range(n):
+        a = rng.integers(-50 if signed else 0, 50, size)
+        if np.issubdtype(np.dtype(dtype), np.floating):
+            a = a + rng.random(size)
+        out.append(np.asarray(a, dtype=dtype))
+    return out
+
+
+# -- reference oracle ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 4, 7, 8])
+def test_reference_paths_agree_on_exact_ops(n):
+    """Tree and ring fold in different orders; for integer sums and for
+    min/max on any dtype the orders are exactly associative, so the two
+    paths must agree (float sums may differ by rounding — documented)."""
+    arrs = _inputs(n, np.int64)
+    for op in ("sum", "max", "min"):
+        t = reference_allreduce(arrs, op, "tree")
+        r = reference_allreduce(arrs, op, "ring")
+        assert np.array_equal(t, r), op
+    f = _inputs(n, np.float64)
+    for op in ("max", "min"):
+        assert np.array_equal(
+            reference_allreduce(f, op, "tree"),
+            reference_allreduce(f, op, "ring"),
+        )
+
+
+def test_reference_rejects_unknown():
+    with pytest.raises(Error):
+        reference_allreduce([np.zeros(3)], "bogus")
+    with pytest.raises(Error):
+        reference_allreduce([np.zeros(3), np.zeros(3)], "sum", path="star")
+
+
+# -- engine vs reference (the acceptance bit-identity matrix) -----------------
+
+
+@pytest.mark.parametrize("n", [2, 3, 5, 8])
+def test_allreduce_bit_identical_to_reference(n):
+    """sum/max/min × tree/ring × float64/int64, 2-8 ranks: every rank's
+    result equals the single-process reference bit for bit."""
+    cases = [
+        (op, path, dtype)
+        for op in ("sum", "max", "min")
+        for path in ("tree", "ring")
+        for dtype in (np.float64, np.int64)
+    ]
+    inputs = {
+        np.float64: _inputs(n, np.float64),
+        np.int64: _inputs(n, np.int64),
+    }
+
+    def body(c, rank):
+        return [
+            c.allreduce(inputs[dtype][rank], op, path=path)
+            for (op, path, dtype) in cases
+        ]
+
+    results = run_collective(n, body)
+    for ci, (op, path, dtype) in enumerate(cases):
+        ref = reference_allreduce(inputs[dtype], op, path)
+        for rank in range(n):
+            got = results[rank][ci]
+            assert got.dtype == np.dtype(dtype)
+            assert np.array_equal(got, ref), (op, path, dtype, rank)
+
+
+def test_allreduce_f32_2d_and_custom_reducer():
+    n = 3
+    arrs = [
+        np.arange(12, dtype=np.float32).reshape(3, 4) * (r + 1)
+        for r in range(n)
+    ]
+
+    def body(c, rank):
+        s = c.allreduce(arrs[rank], "sum", path="tree")
+        # any elementwise f(acc, contrib) callable is a reducer
+        p = c.allreduce(arrs[rank] + 1.0, np.multiply, path="tree")
+        return s, p
+
+    results = run_collective(n, body)
+    ref_s = reference_allreduce(arrs, "sum", "tree")
+    ref_p = reference_allreduce([a + 1.0 for a in arrs], np.multiply, "tree")
+    for rank in range(n):
+        s, p = results[rank]
+        assert s.shape == (3, 4) and np.array_equal(s, ref_s)
+        assert np.array_equal(p, ref_p)
+
+
+def test_size_based_path_choice_and_telemetry():
+    """path=None routes payloads >= ring_bytes over the ring; the
+    tracker.collective.* counters tick."""
+    n = 2
+    big = [np.arange(4096, dtype=np.int64) + r for r in range(n)]
+    small = [np.arange(4, dtype=np.int64) + r for r in range(n)]
+    r0 = {k: v.value() for k, v in coll_mod._ROUNDS.items()}
+    b0 = coll_mod._BYTES.value()
+
+    def body(c, rank):
+        return (
+            c.allreduce(big[rank], "sum"),      # 32KB >= ring_bytes=1024
+            c.allreduce(small[rank], "sum"),    # 32B  -> tree
+        )
+
+    results = run_collective(n, body, ring_bytes=1024)
+    for rank in range(n):
+        assert np.array_equal(
+            results[rank][0], reference_allreduce(big, "sum", "ring")
+        )
+        assert np.array_equal(
+            results[rank][1], reference_allreduce(small, "sum", "tree")
+        )
+    assert coll_mod._ROUNDS["ring"].value() - r0["ring"] == n
+    assert coll_mod._ROUNDS["tree"].value() - r0["tree"] == n
+    assert coll_mod._BYTES.value() > b0
+
+
+@pytest.mark.parametrize("root", [0, 1, 2, 3])
+def test_broadcast_from_any_root(root):
+    n = 4
+    payload = np.arange(19, dtype=np.float64) * 3.5 - 7
+
+    def body(c, rank):
+        buf = payload if rank == root else np.zeros_like(payload)
+        return c.broadcast(buf, root=root)
+
+    for rank, got in enumerate(run_collective(n, body)):
+        assert np.array_equal(got, payload), (root, rank)
+
+
+def test_barrier_orders_ranks():
+    n = 3
+    hits = []
+
+    def body(c, rank):
+        hits.append(("pre", rank))
+        c.barrier()
+        hits.append(("post", rank))
+        return True
+
+    assert all(run_collective(n, body))
+    # every pre happens before any post could complete only if the
+    # barrier is real: the first post entry must come after all n pres
+    first_post = next(i for i, (k, _) in enumerate(hits) if k == "post")
+    assert len([h for h in hits[:first_post] if h[0] == "pre"]) == n
+
+
+def test_world_of_one_is_local():
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    w = RabitWorker("127.0.0.1", tracker.port, jobid="0")
+    w.start(world_size=1)
+    c = Collective(w)
+    a = np.arange(5, dtype=np.float32)
+    assert np.array_equal(c.allreduce(a, "sum"), a)
+    assert np.array_equal(c.broadcast(a, root=0), a)
+    c.barrier()
+    assert c.load_checkpoint(timeout=0.5) == (0, None)
+    with pytest.raises(Error):
+        c.allreduce(a, "bogus")
+    with pytest.raises(Error):
+        c.broadcast(a, root=5)
+    # close() with no live links returns immediately — it must not
+    # busy-spin out the whole linger window throwing _LinkDied
+    t0 = time.perf_counter()
+    c2 = Collective(w)
+    c2.close(linger=5.0)
+    assert time.perf_counter() - t0 < 1.0, "close() spun the linger out"
+    c.close()
+    w.shutdown()
+    tracker.join()
+    tracker.close()
+
+
+def test_oversized_payload_raises_checked_error(monkeypatch):
+    """A payload over the frame limit fails LOUDLY at the sender: the
+    receiver would reject the frame as corrupt and both sides would
+    spin through recovery retrying the identical send forever."""
+    from dmlc_core_tpu.tracker import collective as collective_mod
+
+    eng = Collective.__new__(Collective)  # the check precedes any IO
+    monkeypatch.setattr(collective_mod, "_MAX_PAYLOAD", 8)
+    with pytest.raises(Error, match="frame limit"):
+        eng._send_frame(0, collective_mod.K_DATA, 0, 0, b"x" * 9)
+
+
+# -- fault tolerance ----------------------------------------------------------
+
+
+def test_seeded_link_resets_heal_bit_identical(monkeypatch):
+    """DMLC_COLLECTIVE_FAULTS resets: links are half-closed mid-job at
+    seeded rounds; both endpoints run the reset-flood + re-rendezvous
+    recovery and every round's result stays bit-identical."""
+    monkeypatch.setenv("DMLC_COLLECTIVE_FAULTS", "resets=2,seed=7")
+    n, rounds = 3, 12
+
+    def inp(rank, r):
+        return np.arange(8, dtype=np.float64) * (rank + 1) + r
+
+    def body(c, rank):
+        outs = [
+            c.allreduce(inp(rank, r), "sum", path="tree")
+            for r in range(rounds)
+        ]
+        return outs, c.recoveries
+
+    results = run_collective(n, body)
+    assert sum(rec for _, rec in results) > 0, "no reset ever fired"
+    for r in range(rounds):
+        ref = reference_allreduce([inp(k, r) for k in range(n)], "sum", "tree")
+        for rank in range(n):
+            assert np.array_equal(results[rank][0][r], ref), (rank, r)
+
+
+def test_ring_round_faulted_by_reset_still_exact(monkeypatch):
+    """A ring round a reset aborts retries over the tree; with int64
+    payloads both fold orders are exact, so results must still equal
+    the reference no matter which rounds faulted."""
+    monkeypatch.setenv("DMLC_COLLECTIVE_FAULTS", "resets=2,seed=3")
+    n, rounds = 3, 8
+    arrs = [
+        [np.arange(513, dtype=np.int64) * (k + 1) + r for k in range(n)]
+        for r in range(rounds)
+    ]
+
+    def body(c, rank):
+        return [
+            c.allreduce(arrs[r][rank], "sum", path="ring")
+            for r in range(rounds)
+        ]
+
+    results = run_collective(n, body, ring_bytes=64)
+    for r in range(rounds):
+        ref = reference_allreduce(arrs[r], "sum", "ring")
+        for rank in range(n):
+            assert np.array_equal(results[rank][r], ref), (rank, r)
+
+
+def test_dead_worker_rejoins_bootstraps_and_replays():
+    """The recovery story end to end, in-process: B dies mid-job, A
+    discovers the dead link and re-enters the rendezvous; relaunched B'
+    reclaims rank 1 via the jobid memo, pulls A's lazy checkpoint
+    (params + version), fast-forwards its round clock, replays the
+    missed rounds from A's result cache, and rejoins the live round —
+    every result bit-identical to the reference."""
+    n = 2
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+
+    def inp(rank, r):
+        return np.arange(8, dtype=np.float64) * (rank + 1) + r
+
+    refs = [
+        reference_allreduce([inp(k, r) for k in range(n)], "sum", "tree")
+        for r in range(6)
+    ]
+    a_out, a_err = [], []
+
+    def run_a():
+        try:
+            w = RabitWorker("127.0.0.1", tracker.port, jobid="0")
+            rank = w.start(world_size=n)
+            c = Collective(w, io_timeout=30)
+            for r in range(6):
+                a_out.append(c.allreduce(inp(rank, r), "sum", path="tree"))
+                if r == 2:
+                    c.checkpoint(b"state-after-round-2", version=3)
+            c.close()
+            w.shutdown()
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            a_err.append(e)
+
+    b = RabitWorker("127.0.0.1", tracker.port, jobid="1")
+    ta = threading.Thread(target=run_a)
+    ta.start()
+    rank_b = b.start(world_size=-1)
+    cb = Collective(b, io_timeout=30)
+    for r in range(5):
+        assert np.array_equal(
+            cb.allreduce(inp(rank_b, r), "sum", path="tree"), refs[r]
+        )
+    # B dies abruptly: links torn down, engine dropped mid-job
+    b.close()
+    time.sleep(0.2)
+
+    w2 = RabitWorker("127.0.0.1", tracker.port, jobid="1")
+    assert w2.start(world_size=-1) == rank_b  # jobid memo reclaims rank
+    c2 = Collective(w2, io_timeout=30)
+    version, state = c2.load_checkpoint()
+    assert (version, state) == (3, b"state-after-round-2")
+    assert c2.seq == 3  # fast-forwarded to the checkpoint's round
+    for r in range(3, 6):  # rounds 3-4 replay from A's cache, 5 is live
+        assert np.array_equal(
+            c2.allreduce(inp(rank_b, r), "sum", path="tree"), refs[r]
+        )
+    c2.close()
+    w2.shutdown()
+    ta.join(60)
+    assert not a_err
+    tracker.join()
+    tracker.close()
+
+
+def test_wedged_peer_death_discovered_by_watch_push_not_timeout():
+    """Instant peer-death notification: B wedges WITHOUT closing its
+    sockets (no EOF for A to read), so only the supervisor-driven
+    DeathWatch push can unblock A's round before the io_timeout
+    backstop. A's timeout is set far beyond the test budget — if the
+    push path were broken this test would fail on the join, not pass
+    slowly."""
+    n = 2
+    tracker = RabitTracker("127.0.0.1", n)
+    tracker.start(n)
+
+    def inp(rank, r):
+        return np.arange(6, dtype=np.float64) * (rank + 1) + r
+
+    refs = [
+        reference_allreduce([inp(k, r) for k in range(n)], "sum", "tree")
+        for r in range(2)
+    ]
+    a_out, a_err = [], []
+    a_recovered = threading.Event()
+
+    def run_a():
+        try:
+            w = RabitWorker("127.0.0.1", tracker.port, jobid="0")
+            rank = w.start(world_size=n)
+            c = Collective(w, io_timeout=600)  # backstop way off-budget
+            for r in range(2):
+                a_out.append(c.allreduce(inp(rank, r), "sum", path="tree"))
+            if c.recoveries:
+                a_recovered.set()
+            c.close()
+            w.shutdown()
+        except Exception as e:  # noqa: BLE001
+            import traceback
+
+            traceback.print_exc()
+            a_err.append(e)
+
+    b = RabitWorker("127.0.0.1", tracker.port, jobid="1")
+    ta = threading.Thread(target=run_a)
+    ta.start()
+    rank_b = b.start(world_size=-1)
+    cb = Collective(b, io_timeout=30)
+    assert np.array_equal(
+        cb.allreduce(inp(rank_b, 0), "sum", path="tree"), refs[0]
+    )
+    # B wedges: stops participating, sockets stay open. A blocks in
+    # round 1 until the tracker pushes the death notice.
+    time.sleep(0.3)
+    tracker.watch.note_task_rank("1", rank_b)
+    tracker.watch.notify("1")  # what supervisor.on_task_failure does
+    # relaunched B': the watch push made A's blocked recv fail NOW and
+    # A is re-entering the rendezvous, waiting for this rejoin
+    w2 = RabitWorker("127.0.0.1", tracker.port, jobid="1")
+    assert w2.start(world_size=-1) == rank_b
+    c2 = Collective(w2, io_timeout=30)
+    c2.load_checkpoint(timeout=5)
+    for r in range(c2.seq, 2):
+        assert np.array_equal(
+            c2.allreduce(inp(rank_b, r), "sum", path="tree"), refs[r]
+        )
+    c2.close()
+    w2.shutdown()
+    ta.join(90)
+    assert not ta.is_alive(), "A never unblocked: watch push broken"
+    assert not a_err
+    assert a_recovered.is_set(), "A finished without a recovery?"
+    for r in range(2):
+        assert np.array_equal(a_out[r], refs[r])
+    cb.close(linger=0.0)
+    b.close()
+    tracker.join()
+    tracker.close()
+
+
+# -- DeathWatch unit ----------------------------------------------------------
+
+
+def _pipe_watcher():
+    a, b = socket.socketpair()
+    return FramedSocket(a), FramedSocket(b)
+
+
+def test_deathwatch_fans_out_except_dead_rank():
+    watch = DeathWatch()
+    t0, w0 = _pipe_watcher()
+    t1, w1 = _pipe_watcher()
+    t2, w2 = _pipe_watcher()
+    watch.add(0, t0)
+    watch.add(1, t1)
+    watch.add(2, t2)
+    watch.note_task_rank("job-b", 1)
+    watch.notify("job-b", host="h1")
+    for fs in (w0, w2):
+        fs.sock.settimeout(5)
+        msg = json.loads(fs.recv_str())
+        assert msg["dead_rank"] == 1 and msg["host"] == "h1"
+    # the dead rank's own (stale) connection gets nothing: the frame
+    # would arrive at its relaunched successor
+    w1.sock.settimeout(0.2)
+    with pytest.raises((socket.timeout, TimeoutError, ConnectionError)):
+        w1.recv_str()
+    assert watch.notices == 1
+    watch.close()
+    for fs in (w0, w1, w2):
+        fs.close()
+
+
+def test_deathwatch_unknown_task_falls_back_to_int_and_drops_dead():
+    watch = DeathWatch()
+    t0, w0 = _pipe_watcher()
+    t1, w1 = _pipe_watcher()
+    watch.add(0, t0)
+    watch.add(1, t1)
+    # watcher 0's connection is already dead: fan-out must drop it and
+    # still reach watcher 1
+    w0.close()
+    t0.close()
+    watch.notify(7)  # no task memo: task id IS the rank
+    w1.sock.settimeout(5)
+    assert json.loads(w1.recv_str())["dead_rank"] == 7
+    assert 0 not in watch._watchers
+    # re-registration replaces (relaunched worker's fresh connection)
+    t1b, w1b = _pipe_watcher()
+    watch.add(1, t1b)
+    watch.notify(0)
+    w1b.sock.settimeout(5)
+    assert json.loads(w1b.recv_str())["dead_rank"] == 0
+    watch.close()
+    for fs in (w1, w1b):
+        fs.close()
+
+
+def test_notify_task_failure_is_noop_without_tracker():
+    prev = active_watch()
+    set_active_watch(None)
+    try:
+        notify_task_failure(3, "host")  # must not raise
+    finally:
+        set_active_watch(prev)
+
+
+def test_chaos_spec_validation():
+    from dmlc_core_tpu.tracker.collective import _PeerChaos
+
+    with pytest.raises(Error):
+        _PeerChaos("bogus_knob=1", 0)
+    with pytest.raises(Error):
+        _PeerChaos("resets=abc", 0)
+    with pytest.raises(Error):
+        _PeerChaos("kill_seq=1,kill_phase=middle", 0)
+    c = _PeerChaos("resets=3,seed=5,kill_seq=2,kill_rank=1", 0)
+    assert c.kill_rank == 1 and len(c.events) == 3
+    # same spec + rank => same schedule; different rank => its own draw
+    assert _PeerChaos("resets=3,seed=5", 0).events == _PeerChaos(
+        "resets=3,seed=5", 0
+    ).events
+
+
+# -- the chaos drill ----------------------------------------------------------
+
+DRILL_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import numpy as np
+from dmlc_core_tpu.tracker.client import RabitWorker
+from dmlc_core_tpu.tracker.collective import Collective
+
+w = RabitWorker()
+rank = w.start()
+world = w.world_size
+c = Collective(w, io_timeout=60)
+
+DIM, STEPS, SAVE_EVERY = 64, {steps}, 3
+params = np.zeros(DIM)
+step0 = 0
+if int(os.environ.get("DMLC_NUM_ATTEMPT", "0")) > 0:
+    version, state = c.load_checkpoint()
+    if state:
+        params = np.frombuffer(state, dtype=np.float64).copy()
+        step0 = int(version)
+
+rng = None
+for s in range(step0, STEPS):
+    # deterministic per-(rank, step) "gradient"
+    g = np.sin(np.arange(DIM) * (rank + 1) + s).astype(np.float64)
+    total = c.allreduce(g, "sum", path="tree")
+    params = params - 0.01 * (total / world)
+    if (s + 1) % SAVE_EVERY == 0:
+        c.checkpoint(params.tobytes(), version=s + 1)
+
+out = os.environ["DRILL_OUT"]
+tmp = f"{{out}}.rank{{rank}}.tmp{{os.getpid()}}"
+np.save(tmp + ".npy", params)
+os.replace(tmp + ".npy", f"{{out}}.rank{{rank}}.npy")
+c.close()
+w.shutdown()
+"""
+
+
+def _run_drill(tmp_path, tag, steps, faults):
+    """3-worker SGD job under a real Supervisor; returns per-rank final
+    params. ``faults`` is the DMLC_COLLECTIVE_FAULTS spec ('' = clean)."""
+    tracker = RabitTracker("127.0.0.1", 3)
+    tracker.start(3)
+    out = str(tmp_path / f"model_{tag}")
+    script = tmp_path / f"drill_{tag}.py"
+    script.write_text(DRILL_WORKER.format(repo=str(REPO), steps=steps))
+
+    def launch(task_id, host, attempt):
+        env = os.environ.copy()
+        env.update({
+            "DMLC_TRACKER_URI": "127.0.0.1",
+            "DMLC_TRACKER_PORT": str(tracker.port),
+            "DMLC_TASK_ID": str(task_id),
+            "DMLC_NUM_ATTEMPT": str(attempt),
+            "DRILL_OUT": out,
+            "JAX_PLATFORMS": "cpu",
+        })
+        env.pop("DMLC_COLLECTIVE_FAULTS", None)
+        if faults:
+            env["DMLC_COLLECTIVE_FAULTS"] = faults
+        return subprocess.Popen([sys.executable, str(script)], env=env)
+
+    sup = Supervisor(
+        launch, hosts=["localhost"], max_attempt=3,
+        host_fail_limit=float("inf"), relaunch_backoff=0.1,
+        on_task_failure=[
+            # exactly what backends/local.py registers: reclaim +
+            # instant peer-death notification, coexisting
+            __import__(
+                "dmlc_core_tpu.tracker.shardsvc", fromlist=["reclaim_task"]
+            ).reclaim_task,
+            notify_task_failure,
+        ],
+    )
+    try:
+        sup.run(3)
+    finally:
+        tracker.close()
+    models = [np.load(f"{out}.rank{r}.npy") for r in range(3)]
+    return models, sup
+
+
+def test_chaos_drill_kill_mid_round_equals_clean_run(tmp_path):
+    """THE acceptance drill: a 3-worker allreduce-SGD job, one worker
+    SIGKILLed at the start of round 4 (a checkpoint exists at round 3,
+    so the relaunch exercises true bootstrap-from-peer + replay), the
+    supervisor relaunches it, the DeathWatch unblocks the survivors —
+    final model BIT-IDENTICAL to the clean run, on every rank."""
+    steps = 10
+    clean, _ = _run_drill(tmp_path, "clean", steps, "")
+    chaos, sup = _run_drill(
+        tmp_path, "chaos", steps,
+        "kill_seq=4,kill_rank=2,kill_phase=start",
+    )
+    assert sup.relaunches >= 1, "the kill never fired"
+    for r in range(3):
+        assert np.array_equal(clean[r], clean[0]), f"clean rank {r} differs"
+        assert np.array_equal(chaos[r], chaos[0]), f"chaos rank {r} differs"
+    assert np.array_equal(chaos[0], clean[0]), (
+        "final model with injected kill+relaunch != clean run"
+    )

@@ -420,6 +420,58 @@ def test_rendezvous_cmd_set_matches_protocol():
     assert lint._L013_CMDS == protocol.RENDEZVOUS_CMDS
 
 
+def test_socket_construction_flagged_in_tracker(tmp_path):
+    """L014: raw socket construction inside dmlc_core_tpu/tracker/ is
+    confined to protocol.py (listeners + dials) and collective.py (the
+    peer-link data plane) — an ad-hoc socket forks connect/IO-timeout
+    policy per call site."""
+    assert [c for c, _ in _tracker_findings(
+        "import socket\ns = socket.socket()\n", tmp_path)] == ["L014"]
+    assert [c for c, _ in _tracker_findings(
+        "import socket\n"
+        "s = socket.create_connection(('h', 1), timeout=30)\n", tmp_path)
+    ] == ["L014"]
+    assert [c for c, _ in _tracker_findings(
+        "import socket as sk\ns = sk.socket(sk.AF_INET)\n", tmp_path)
+    ] == ["L014"]
+    assert [c for c, _ in _tracker_findings(
+        "from socket import socket as mksock\ns = mksock()\n", tmp_path)
+    ] == ["L014"]
+    assert [c for c, _ in _tracker_findings(
+        "from socket import create_connection\n"
+        "s = create_connection(('h', 1))\n", tmp_path)] == ["L014"]
+    # per-line opt-out works like every other rule (the UDP route probe)
+    assert _tracker_findings(
+        "import socket\n"
+        "s = socket.socket()  # noqa: L014 (fixture)\n", tmp_path
+    ) == []
+
+
+def test_socket_construction_quiet_outside_scope_and_in_owners(tmp_path):
+    # tests/benches build raw sockets deliberately — out of scope
+    assert codes("import socket\ns = socket.socket()\n", tmp_path) == []
+    # elsewhere in the library too (io/ has its own L010 governing this)
+    assert _lib_findings(
+        "import socket  # noqa: L010\n"
+        "s = socket.socket()\n", tmp_path) == []
+    # the two sanctioned wire modules are exempt
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True, exist_ok=True)
+    for owner in ("protocol.py", "collective.py"):
+        f = d / owner
+        f.write_text("import socket\ns = socket.socket()\n")
+        assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # socket-module REFERENCES (constants, type annotations) are not
+    # construction
+    assert _tracker_findings(
+        "import socket\nx = socket.SHUT_RDWR\n"
+        "def f(s: socket.socket) -> None:\n    s.close()\n", tmp_path
+    ) == []
+    # an unrelated object's .socket attribute is not the socket module
+    assert _tracker_findings(
+        "s = server.socket.accept()\n", tmp_path) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -69,6 +69,47 @@ def test_relaunch_until_success():
     ]
 
 
+def test_multiple_failure_observers_all_fire():
+    """on_task_failure is a LIST, not last-writer-wins: the shard
+    service's lease reclaim and the collective engine's peer-death
+    notification must coexist. Both fire per failure in registration
+    order, and one raising does not rob the others (or the relaunch)."""
+    calls = []
+
+    def shardsvc_reclaim(task_id, host):
+        calls.append(("reclaim", task_id, host))
+
+    def collective_notify(task_id, host):
+        calls.append(("notify", task_id, host))
+
+    def bad_observer(task_id, host):
+        calls.append(("bad", task_id, host))
+        raise RuntimeError("observer bug")
+
+    def launch(task_id, host, attempt):
+        # task 0 fails once, then succeeds
+        if task_id == 0 and attempt == 0:
+            return FakeProc(1)
+        return FakeProc(0)
+
+    sup = Supervisor(launch, hosts=["h0"], max_attempt=3, poll_interval=0,
+                     relaunch_backoff=0,
+                     on_task_failure=[shardsvc_reclaim, bad_observer])
+    sup.add_on_task_failure(collective_notify)
+    sup.run(2)
+    assert calls == [
+        ("reclaim", 0, "h0"),
+        ("bad", 0, "h0"),
+        ("notify", 0, "h0"),
+    ]
+    assert sup.relaunches == 1  # the raising observer didn't abort it
+    # a single callable still works (the pre-list signature)
+    calls.clear()
+    sup2 = Supervisor(launch, hosts=["h0"], max_attempt=3, poll_interval=0,
+                      relaunch_backoff=0, on_task_failure=shardsvc_reclaim)
+    assert sup2.on_task_failure == [shardsvc_reclaim]
+
+
 def test_abort_past_budget_kills_survivors():
     """One more failure than max_attempt aborts the job and kills every
     still-running task (reference AM abort, ApplicationMaster.java:564)."""

@@ -4,6 +4,7 @@ level deeper: actual TCP rank assignment + peer wiring in-process),
 backend command builders, and the dmlc-submit CLI."""
 
 import os
+import random
 import socket
 import sys
 import threading
@@ -62,6 +63,122 @@ def test_link_map_ring_order(n):
     for r in range(n):
         assert ring[r] == ((r - 1) % n, (r + 1) % n)
     assert parent[0] == -1
+
+
+def _fuzzed_ns(count=40, lo=1, hi=311, seed=0xD31C):
+    """Deterministic fuzz draw for the topology property tests: a
+    seeded spread over world sizes including the awkward shapes
+    (1, 2, powers of two ± 1) plus random fill."""
+    rng = random.Random(seed)
+    ns = {1, 2, 3, 4, 5, 7, 8, 9, 15, 16, 17, 31, 32, 33, 63, 64, 65}
+    while len(ns) < count:
+        ns.add(rng.randint(lo, hi))
+    return sorted(ns)
+
+
+def test_property_ring_is_hamiltonian_fuzzed():
+    """For fuzzed n: following ring-next from 0 visits every rank
+    exactly once and closes the loop, and prev/next are inverses —
+    get_ring is a Hamiltonian cycle over the tree."""
+    for n in _fuzzed_ns():
+        tree_map, parent_map = topology.get_tree(n)
+        ring = topology.get_ring(tree_map, parent_map)
+        assert sorted(ring) == list(range(n))
+        seen = []
+        cur = 0
+        for _ in range(n):
+            seen.append(cur)
+            cur = ring[cur][1]
+        assert cur == 0, f"n={n}: ring does not close at 0"
+        assert sorted(seen) == list(range(n)), f"n={n}: not Hamiltonian"
+        for r in range(n):
+            prev, nxt = ring[r]
+            assert ring[prev][1] == r and ring[nxt][0] == r, (
+                f"n={n}: prev/next not inverse at rank {r}"
+            )
+
+
+def test_property_link_map_relabel_is_bijection_fuzzed():
+    """For fuzzed n: get_link_map's relabeling is a bijection on
+    range(n) and an isomorphism — the relabeled tree/parent/ring are
+    exactly the original maps with every rank pushed through one
+    permutation (ring position, so relabeled ring-next is rank+1)."""
+    for n in _fuzzed_ns():
+        tree_map, parent_map = topology.get_tree(n)
+        ring = topology.get_ring(tree_map, parent_map)
+        tree2, parent2, ring2 = topology.get_link_map(n)
+        # the relabeling is ring position: reconstruct it independently
+        relabel = {}
+        cur = 0
+        for pos in range(n):
+            relabel[cur] = pos
+            cur = ring[cur][1]
+        # bijection on range(n), and every returned map is keyed by it
+        assert sorted(relabel) == list(range(n))
+        assert sorted(relabel.values()) == list(range(n))
+        assert sorted(tree2) == list(range(n))
+        assert sorted(parent2) == list(range(n))
+        assert sorted(ring2) == list(range(n))
+        # isomorphism: edges/parents/ring all commute with the relabel
+        for r in range(n):
+            assert sorted(tree2[relabel[r]]) == sorted(
+                relabel[x] for x in tree_map[r]
+            ), f"n={n}: tree edges not preserved at rank {r}"
+            if r == 0:
+                assert parent2[relabel[0]] == -1
+            else:
+                assert parent2[relabel[r]] == relabel[parent_map[r]]
+            a, b = ring[r]
+            assert ring2[relabel[r]] == (relabel[a], relabel[b])
+            assert ring2[relabel[r]] == (
+                (relabel[r] - 1) % n,
+                (relabel[r] + 1) % n,
+            ), f"n={n}: relabeled ring not 0..n-1 order"
+
+
+def test_property_ring_shares_tree_edges_fuzzed():
+    """For fuzzed n: the edges the reference share-ring algorithm
+    (find_share_ring, tracker.py:193-211) guarantees land on tree
+    links actually do — every internal node's ring-next is its FIRST
+    child (the DFS descends before it walks), and the wrap-around edge
+    (last ring position → root) is the root's last child because the
+    last subtree is traversed in reverse. So the ring shares at least
+    (#internal nodes + 1) edges with the tree."""
+    for n in _fuzzed_ns():
+        if n < 2:
+            continue
+        tree_map, parent_map = topology.get_tree(n)
+        ring = topology.get_ring(tree_map, parent_map)
+        ring_edges = {frozenset((r, ring[r][1])) for r in range(n)}
+        # every internal node starts its DFS sub-order [v, c1, ...]:
+        # {v, first child} stays consecutive through concatenation AND
+        # the last-child reversal (reversal flips direction, not
+        # adjacency), so it must be a ring edge
+        must_share = set()
+        for v in range(n):
+            children = [x for x in tree_map[v] if x != parent_map[v]]
+            if children:
+                must_share.add(frozenset((v, children[0])))
+        # the global order ends at the root's LAST child (its reversed
+        # sub-order ends with the child itself), so the wrap-around
+        # edge is the tree edge {root, last child}
+        last = ring[0][0]
+        assert parent_map[last] == 0, (
+            f"n={n}: wrap-around rank {last} is not a root child"
+        )
+        must_share.add(frozenset((0, last)))
+        missing = must_share - ring_edges
+        assert not missing, (
+            f"n={n}: reference-guaranteed shared edges missing from "
+            f"the ring: {sorted(tuple(e) for e in missing)}"
+        )
+        tree_edges = {
+            frozenset((r, x)) for r in range(n) for x in tree_map[r]
+        }
+        shared = ring_edges & tree_edges
+        assert len(shared) >= len(must_share), (
+            f"n={n}: only {len(shared)} ring edges shared with the tree"
+        )
 
 
 # -- rendezvous over real sockets -------------------------------------------
@@ -154,6 +271,71 @@ def test_tracker_worker_envs():
     assert envs["DMLC_TRACKER_URI"] == "127.0.0.1"
     assert isinstance(envs["DMLC_TRACKER_PORT"], int)
     tracker.close()
+
+
+def test_await_peer_links_times_out_on_half_dead_peer(monkeypatch):
+    """Regression: _await_peer_links used to block forever on a peer
+    that connects but never identifies (and on one that never dials at
+    all). The shared deadline must fail the worker loudly and leave it
+    retryable — listener closed, no half-wired links kept."""
+    from dmlc_core_tpu.tracker.protocol import make_listener
+
+    monkeypatch.setenv("DMLC_LINK_WAIT_TIMEOUT", "0.5")
+    w = RabitWorker("127.0.0.1", 1, jobid="x")
+    w.rank = 0
+    w._listener = make_listener("127.0.0.1", 0)
+    port = w._listener.getsockname()[1]
+    # a half-dead peer: dials in, sends NOTHING
+    mute = socket.create_connection(("127.0.0.1", port), timeout=5)
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="timed out .* incoming peer"):
+        w._await_peer_links(2)  # the second peer never even dials
+    assert time.monotonic() - t0 < 5, "deadline not enforced"
+    assert w.links == {}  # the unidentified accept was not kept
+    assert w._listener.fileno() < 0  # listener closed: start() retryable
+    mute.close()
+    w.close()
+
+
+def test_worker_shutdown_and_close_are_idempotent():
+    """Regression: double shutdown() used to re-send cmd=shutdown (a
+    tracker protocol violation) and double close() could raise on the
+    already-closed listener. Both must be safe no-ops the second time —
+    teardown paths race (atexit + explicit close)."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    w = RabitWorker("127.0.0.1", tracker.port, jobid="0")
+    assert w.start(world_size=1) == 0
+    w.shutdown()
+    w.shutdown()  # second signal: no duplicate cmd, no raise
+    w.close()  # close after shutdown: no raise
+    w.close()
+    assert w.links == {} and w._listener is None
+    tracker.join()
+    tracker.close()
+
+
+def test_peer_connect_timeout_is_explicit(monkeypatch):
+    """Regression: the peer dial rides $DMLC_PEER_CONNECT_TIMEOUT — a
+    worker constructed under the knob carries it, and connect_peer
+    enforces the deadline on the identify send as well as the dial (a
+    listener that accepts but never reads must not wedge the dialer)."""
+    from dmlc_core_tpu.tracker.protocol import connect_peer, make_listener
+
+    monkeypatch.setenv("DMLC_PEER_CONNECT_TIMEOUT", "2.5")
+    w = RabitWorker("127.0.0.1", 1, jobid="x")
+    assert w.connect_timeout == 2.5
+    lst = make_listener("127.0.0.1", 0, backlog=1)
+    port = lst.getsockname()[1]
+    sock = connect_peer("127.0.0.1", port, 3, timeout=2.5)
+    # wired links are handed over in blocking mode: consumers (the
+    # collective engine) set their own per-op deadlines
+    assert sock.gettimeout() is None
+    peer, _ = lst.accept()
+    assert FramedSocket(peer).recv_int() == 3  # identified with our rank
+    sock.close()
+    peer.close()
+    lst.close()
 
 
 # -- hostile clients: the accept loop must survive and finish the job --------

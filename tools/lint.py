@@ -67,7 +67,7 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  wire protocol's command strings —
                                  start/recover/shutdown/print/metrics/
                                  shard_lease/shard_renew/shard_done/
-                                 shard_release —
+                                 shard_release/watch —
                                  are spelled out in exactly one place:
                                  tracker/protocol.py's CMD_* constants.
                                  A literal elsewhere in tracker/ can
@@ -75,6 +75,19 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  protocol check never catches; compare
                                  and send the constants. Tests crafting
                                  raw frames live outside the scope.)
+  L014 raw socket construction in dmlc_core_tpu/tracker/ (the wire
+                                 layer owns TCP plumbing: protocol.py —
+                                 listeners via make_listener /
+                                 bind_first_free / find_free_port,
+                                 dials via connect_worker /
+                                 connect_peer — and collective.py (the
+                                 peer-link data plane) are exempt; a
+                                 socket.socket( / create_connection(
+                                 elsewhere in tracker/ forks timeout
+                                 and error-handling policy per call
+                                 site. Genuine non-wire uses — the UDP
+                                 route probe in get_host_ip — opt out
+                                 per line with `# noqa: L014`.)
   L012 thread-pool creation in dmlc_core_tpu/io/ (exactly two pools are
                                  sanctioned: codec.py's decode pool —
                                  sized by the cgroup/affinity-aware
@@ -369,6 +382,11 @@ _L012_EXEMPT = ("/io/codec.py", "/io/spanfetch.py")
 # protocol.RENDEZVOUS_CMDS by a test (tests/test_lint.py).
 _L013_SCOPE_DIRS = ("dmlc_core_tpu/tracker/",)
 _L013_EXEMPT = ("/tracker/protocol.py",)
+# L014 is scoped to dmlc_core_tpu/tracker/ and exempts the two
+# sanctioned wire modules: protocol.py (listeners + dials) and
+# collective.py (the peer-link data plane)
+_L014_SCOPE_DIRS = ("dmlc_core_tpu/tracker/",)
+_L014_EXEMPT = ("/tracker/protocol.py", "/tracker/collective.py")
 _L013_CMDS = frozenset(
     {
         "start",
@@ -380,6 +398,7 @@ _L013_CMDS = frozenset(
         "shard_renew",
         "shard_done",
         "shard_release",
+        "watch",
     }
 )
 
@@ -506,6 +525,50 @@ def _check_thread_pool_creation(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+_SOCKET_CTORS = ("socket", "create_connection")
+
+
+def _check_socket_construction(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call constructing a TCP socket — ``socket.socket(...)`` /
+    ``socket.create_connection(...)`` under any module alias, or the
+    bare names bound by ``from socket import socket/create_connection``
+    (with or without an alias): inside dmlc_core_tpu/tracker/ the wire
+    layer is one place (protocol.py's make_listener / bind_first_free /
+    find_free_port / connect_worker / connect_peer, and collective.py's
+    peer-link data plane), mirroring the L006/L008-L013 single-site
+    pattern — an ad-hoc socket forks connect/IO-timeout policy and
+    error handling per call site. Scoped in lint_file; the UDP route
+    probe opts out per line with ``# noqa: L014``."""
+    fn_aliases = set()
+    mod_aliases = {"socket"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "socket":
+            for alias in node.names:
+                if alias.name in _SOCKET_CTORS:
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "socket":
+                    mod_aliases.add(alias.asname or "socket")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+            isinstance(f, ast.Attribute)
+            and f.attr in _SOCKET_CTORS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mod_aliases
+        )
+        if hit:
+            yield node.lineno, (
+                "raw socket construction in tracker/ (listeners/dials "
+                "belong to tracker/protocol.py — make_listener, "
+                "bind_first_free, find_free_port, connect_worker, "
+                "connect_peer)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -520,6 +583,7 @@ CHECKS = [
     ("L011", _check_trace_event_literals),
     ("L012", _check_thread_pool_creation),
     ("L013", _check_rendezvous_cmd_literals),
+    ("L014", _check_socket_construction),
 ]
 
 
@@ -592,6 +656,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L013_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L013_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L014":
+            if posix.endswith(_L014_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L014_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L014_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
