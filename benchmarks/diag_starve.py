@@ -193,6 +193,90 @@ def dynamic_shard_drain(fault: str = ""):
                 os.environ[k] = v
 
 
+def dsserve_drain(fault: str = ""):
+    """``--dsserve``: drain the bench shard through the disaggregated
+    preprocessing service end to end IN PROCESS (ISSUE 12): a local
+    tracker, one DsServeServer thread leasing its micro-shards, and the
+    ``dsserve://`` client source pulling packed slots over loopback.
+    The numbers this isolates: the wire/framing overhead on top of the
+    identical local pipeline (compare rows/s with ``--shuffle``'s
+    window mode), the client's recv-wait profile (``dsserve_recv_wait``
+    is where a network/server-bound trainer stalls), and the server's
+    produce-vs-send overlap (queue_depth). ``fault`` wraps the DATA
+    reads in a fault:// spec — the SERVER then rides the retry layer,
+    the client only ever sees clean slots (chaos composes)."""
+    import bench
+    from dmlc_core_tpu.dsserve import DsServeBatches, DsServeServer
+    from dmlc_core_tpu.io.faults import wrap_uri
+    from dmlc_core_tpu.staging.batcher import BatchSpec
+    from dmlc_core_tpu.telemetry import default_registry
+    from dmlc_core_tpu.tracker.tracker import RabitTracker
+
+    bench.ensure_rec_data()
+    bench.ensure_rec_index()
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    prev_env = {
+        k: os.environ.get(k)
+        for k in ("DMLC_TRACKER_URI", "DMLC_TRACKER_PORT")
+    }
+    os.environ["DMLC_TRACKER_URI"] = "127.0.0.1"
+    os.environ["DMLC_TRACKER_PORT"] = str(tracker.port)
+    server = DsServeServer(rank=1001).start()
+    try:
+        uri = (
+            f"{wrap_uri(bench.REC_DATA, fault)}?index={bench.REC_INDEX}"
+            "&shuffle=record&seed=1"
+        )
+        spec = BatchSpec(
+            batch_size=4096, layout="ell", max_nnz=bench.REC_K
+        )
+        src = DsServeBatches(
+            f"dsserve://127.0.0.1:{server.port}"
+            + ("" if uri.startswith("/") else "/") + uri,
+            spec, mode="lease",
+        )
+        t0 = time.perf_counter()
+        rows = nbytes = slots = 0
+        for b in src:
+            rows += b.n_valid
+            nbytes += b.packed.nbytes
+            slots += 1
+        dt = time.perf_counter() - t0
+        stats = src.io_stats()
+        src.close()
+        reg = default_registry()
+        wait = reg.histogram("dsserve.recv_wait_seconds").snapshot()
+        return {
+            "drain": {
+                "rows_per_sec": round(rows / dt, 1),
+                "slot_mb_per_sec": round(nbytes / dt / 1e6, 1),
+                "secs": round(dt, 3),
+                "rows": rows,
+                "slots": slots,
+                **stats,
+            },
+            # per-stage view: recv_wait is the trainer-side stall (the
+            # dsserve_recv_wait stage on a merged timeline); the
+            # server's counters show what the preprocessing side did
+            "recv_wait_seconds": {
+                k: wait[k]
+                for k in ("count", "p50", "p90", "p99")
+                if k in wait
+            },
+            "server": server.stats(),
+            "ledger": tracker.shards.summary(),
+        }
+    finally:
+        server.close()
+        tracker.close()
+        for k, v in prev_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def _print_telemetry() -> None:
     """Exit dump of the process telemetry registry: every counter the
     drained layers ticked (split shape, retry/fault, staging) in one
@@ -292,6 +376,14 @@ def main():
         if "--fault" in sys.argv:  # e.g. --fault latency_ms=20,spikes=50
             fault = sys.argv[sys.argv.index("--fault") + 1]
         print(json.dumps(dynamic_shard_drain(fault), indent=1))
+        _print_telemetry()
+        _dump_trace(trace_path)
+        return
+    if "--dsserve" in sys.argv:
+        fault = ""
+        if "--fault" in sys.argv:  # e.g. --fault resets=2,seed=7
+            fault = sys.argv[sys.argv.index("--fault") + 1]
+        print(json.dumps(dsserve_drain(fault), indent=1))
         _print_telemetry()
         _dump_trace(trace_path)
         return

@@ -1,0 +1,22 @@
+"""dsserve: disaggregated preprocessing over the wire (docs/dsserve.md).
+
+The input pipeline up to the ring-slot boundary — fetch → decode →
+gather-parse → pack — promoted into standalone CPU worker processes
+(tf.data-service style): a :class:`DsServeServer` runs the existing
+fused/generic producers and streams finished page-layout packed slots
+(the exact ``alloc_packed_slot`` byte layout the staging pipeline
+DMAs) over a length-prefixed binary framing; the trainer-side
+``dsserve://host:port,host:port/...`` source (:class:`DsServeBatches`)
+satisfies the staging producer contract, so the trainer's transfer
+ring does nothing but receive frames and issue one ``device_put`` per
+device. Shard assignment rides the PR-10 shard service unchanged —
+preprocessing workers are just leaseholders — and the CLIENT commits
+``shard_done``, so delivery and exactly-once accounting are one
+decision (a server killed mid-stream costs a lease TTL, never a
+duplicated or lost row).
+"""
+
+from .client import DsServeBatches, parse_dsserve_uri
+from .server import DsServeServer
+
+__all__ = ["DsServeBatches", "DsServeServer", "parse_dsserve_uri"]

@@ -1,0 +1,501 @@
+"""dsserve client: the ``dsserve://`` staging producer.
+
+``DsServeBatches`` satisfies the staging producer contract (iterable of
+Batch + ``close()`` + ``io_stats()``), so the trainer composes it with
+``StagingPipeline`` exactly like a local fused producer — except the
+host side does nothing but receive frames into slot buffers and hand
+them to the dispatch ring: fetch, decode, gather-parse and pack all
+happened on the dsserve tier.
+
+URI shape: ``dsserve://host:port,host:port/<dataset-uri>`` — the part
+after the endpoint list is the dataset URI the SERVERS read (query
+sugar included), e.g. ``dsserve://10.0.0.5:7070/data/criteo.rec?index=
+/data/criteo.idx&shuffle=record&seed=3``.
+
+Striping + failover (docs/dsserve.md):
+
+- **lease mode** (default whenever ``DMLC_TRACKER_URI`` is set): every
+  endpoint leases micro-shards from the PR-10 shard service, so
+  striping is dynamic work-sharing — a slow server simply streams
+  fewer shards. The CLIENT commits ``shard_done``: a shard's slots are
+  buffered per connection until its SHARD_FIN arrives, then committed
+  and delivered on ``recorded`` (dropped on ``duplicate``) — delivery
+  and exactly-once accounting are one decision, so a server killed
+  mid-stream (its partial shard dropped with the connection, its lease
+  TTL-reclaimed, the shard re-served in full by a survivor) can never
+  duplicate or lose rows.
+- **static mode** (no tracker): endpoint *i* streams stripe
+  ``(part=i, nparts=n_endpoints)``; slots deliver immediately. A
+  transient connection drop re-dials the same endpoint with
+  ``start_seq`` = slots already delivered — the reopen-and-seek resume
+  of ``RetryingReadStream``, exact because the stream is deterministic.
+
+Reconnects ride ``RetryPolicy`` (io/retry.py) with the transient
+classifier and its consecutive-stall attempt cap; waiting on the
+shared receive queue is the ``dsserve_recv_wait`` stall stage
+(``dsserve.recv_wait_seconds``).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..io.retry import RetryPolicy, is_transient
+from ..io.split import fileset_signature
+from ..io.uri import URISpec
+from ..staging.batcher import Batch, BatchSpec
+from ..telemetry import default_registry as _default_registry
+from ..utils.logging import Error, check
+from ..utils.profiler import annotate
+from . import wire
+
+__all__ = ["DsServeBatches", "parse_dsserve_uri"]
+
+_REG = _default_registry()
+_RECV_WAIT = _REG.histogram(
+    "dsserve.recv_wait_seconds",
+    help="trainer-side wait for the next remote slot (secs)",
+)
+_RECONNECTS = _REG.counter(
+    "dsserve.reconnects", help="client stream reconnect attempts"
+)
+
+
+def parse_dsserve_uri(uri: str) -> Tuple[List[Tuple[str, int]], str]:
+    """``dsserve://h1:p1,h2:p2/<dataset-uri>`` → (endpoints, inner URI).
+
+    The inner URI is whatever the servers should open: a bare path
+    becomes absolute (``/data/x.rec``); a nested scheme
+    (``dsserve://h:p/s3://...``) passes through untouched."""
+    check(uri.startswith("dsserve://"), f"not a dsserve URI: {uri!r}")
+    rest = uri[len("dsserve://"):]
+    netloc, sep, inner = rest.partition("/")
+    check(bool(sep) and bool(inner), f"dsserve URI has no dataset: {uri!r}")
+    endpoints: List[Tuple[str, int]] = []
+    for ep in netloc.split(","):
+        host, colon, port = ep.rpartition(":")
+        check(
+            bool(colon) and port.isdigit() and bool(host),
+            f"bad dsserve endpoint {ep!r} (need host:port)",
+        )
+        endpoints.append((host, int(port)))
+    if "://" not in inner:
+        inner = "/" + inner
+    return endpoints, inner
+
+
+class _CommitRefused(Error):
+    """The tracker refused a shard_done (stale fileset signature, aged
+    epoch). Retrying the STREAM cannot fix a protocol refusal — the
+    endpoint goes terminal immediately instead of burning reconnect
+    cycles re-streaming whole micro-shards (the same loud-stop the
+    DynamicShardSource takes on a refused done)."""
+
+
+class _EndpointState:
+    __slots__ = (
+        "slots", "bytes", "reconnects", "dead", "finished", "sock",
+        "delivered",
+    )
+
+    def __init__(self) -> None:
+        self.slots = 0
+        self.bytes = 0
+        self.reconnects = 0
+        self.dead = False
+        self.finished = False
+        self.sock = None
+        # static-mode resume point: slots already handed downstream on
+        # this endpoint's stripe. Lives HERE (not a _drain_stream
+        # local) so a connection dropping mid-stream cannot roll the
+        # reconnect HELLO's start_seq back and re-deliver slots.
+        self.delivered = 0
+
+
+class DsServeBatches:
+    """Remote packed-slot Batch stream over one or more dsserve servers.
+
+    ``spec`` must match what the servers will produce (it is shipped in
+    the HELLO and drives producer construction server-side). ``mode``
+    defaults to ``lease`` when a tracker address is in the environment,
+    else ``static``. One instance is one epoch (``epoch`` ctor arg) —
+    the per-epoch construction mirror of the local producer path.
+
+    Hooks (settable attributes, the DynamicShardSource idiom):
+    ``on_slot(shard, seq, payload)`` fires per DELIVERED slot,
+    ``on_shard_done(shard, status)`` after this client's commit is
+    acked (``recorded`` | ``duplicate``) — tests and bench hash
+    per-shard payload bytes from these for end-to-end identity.
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        spec: BatchSpec,
+        epoch: int = 0,
+        format: str = "auto",
+        mode: Optional[str] = None,
+        prefetch: int = 8,
+        connect_timeout: float = 10.0,
+        rank: Optional[int] = None,
+    ) -> None:
+        self.endpoints, self.inner_uri = parse_dsserve_uri(uri)
+        self.spec = spec
+        self.epoch = int(epoch)
+        self.format = format
+        if mode is None:
+            mode = (
+                "lease" if os.environ.get("DMLC_TRACKER_URI") else "static"
+            )
+        check(mode in ("lease", "static"), f"bad dsserve mode {mode!r}")
+        self.mode = mode
+        self._connect_timeout = connect_timeout
+        ispec = URISpec(self.inner_uri, 0, 1)
+        index_uri = str(ispec.args["index"]) if "index" in ispec.args else ""
+        fmt = str(
+            ispec.args.get("format", format if format != "auto" else "rowrec")
+        )
+        # the type string must resolve exactly as io_split.create()
+        # resolves it (an ?index= promotes recordio to indexed_recordio
+        # there BEFORE signing), so dsserve consumers and
+        # dynamic-shard workers sharing one tracker sign the same
+        # dataset identically and neither is refused
+        if fmt == "rowrec":
+            src_type = "indexed_recordio" if index_uri else "recordio"
+        else:
+            src_type = "text"
+        self.fileset = fileset_signature(ispec.uri, index_uri, src_type)
+        self._lease_client = None
+        if mode == "lease":
+            from ..tracker.shardsvc import ShardLeaseClient
+
+            try:
+                self._lease_client = ShardLeaseClient(rank=rank)
+            except KeyError as e:
+                raise Error(
+                    "dsserve lease mode needs a tracker: set "
+                    f"DMLC_TRACKER_URI/DMLC_TRACKER_PORT (missing {e})"
+                ) from None
+        self._out: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
+        self._kill = threading.Event()
+        self._commit_lock = threading.Lock()
+        self._eps = [_EndpointState() for _ in self.endpoints]
+        self.shards_recorded = 0
+        self.shards_duplicate = 0
+        self.recv_wait_secs = 0.0
+        self.on_slot = None
+        self.on_shard_done = None
+        self._threads: List[threading.Thread] = []
+        for i in range(len(self.endpoints)):
+            t = threading.Thread(
+                target=self._run_endpoint,
+                args=(i,),
+                daemon=True,
+                name=f"dsserve-recv-{i}",
+            )
+            self._threads.append(t)
+            t.start()
+
+    # -- connection machinery ------------------------------------------------
+    def _hello(self, i: int, start_seq: int) -> Dict:
+        s = self.spec
+        meta: Dict = {
+            "uri": self.inner_uri,
+            "format": self.format,
+            "epoch": self.epoch,
+            "mode": self.mode,
+            "fileset": self.fileset,
+            "spec": {
+                "batch_size": s.batch_size,
+                "layout": s.layout,
+                "max_nnz": s.max_nnz,
+                "num_features": s.num_features,
+                "overflow": s.overflow,
+                "index_dtype": str(s.index_dtype),
+                "value_dtype": str(s.value_dtype),
+            },
+        }
+        if self.mode == "static":
+            meta["part"] = i
+            meta["nparts"] = len(self.endpoints)
+            meta["start_seq"] = start_seq
+        return meta
+
+    def _connect(self, i: int, start_seq: int):
+        host, port = self.endpoints[i]
+        sock = socket.create_connection(
+            (host, port), timeout=self._connect_timeout
+        )
+        try:
+            wire.send_frame(sock, wire.KIND_HELLO, self._hello(i, start_seq))
+            kind, meta, _p, _s, _e = wire.recv_frame(sock)
+            if kind == wire.KIND_ERROR:
+                raise Error(
+                    f"dsserve server {host}:{port} refused the stream: "
+                    f"{meta.get('error')}"
+                )
+            if kind != wire.KIND_OK:
+                raise Error(f"dsserve: expected OK, got frame kind {kind}")
+            sock.settimeout(None)
+            return sock
+        except BaseException:
+            sock.close()
+            raise
+
+    def _put(self, item) -> bool:
+        """Bounded put that stays responsive to close()."""
+        while not self._kill.is_set():
+            try:
+                self._out.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _commit_shard(self, shard: int, pending: List) -> None:
+        """The exactly-once decision point: this client's ``shard_done``
+        is the cluster-wide commit; deliver on ``recorded``, drop on
+        ``duplicate`` (another stream already delivered this shard)."""
+        if self._kill.is_set():
+            return  # never commit work the consumer abandoned
+        status = "recorded"
+        complete = False
+        # pending may legitimately be EMPTY: an oversplit beyond the
+        # file's record count makes some micro-shards zero-row, and
+        # they must still be committed or the epoch ledger never
+        # completes (the DynamicShardSource commits them the same way)
+        # commit AND delivery under one lock: (a) two connections
+        # finishing the same (stolen) shard resolve through the tracker
+        # one at a time so exactly one delivers; (b) when the ledger
+        # answers epoch_complete, every previously recorded shard's
+        # batches are already queued — the epoch-done sentinel below is
+        # therefore ordered after ALL deliveries, and the main iterator
+        # can finish on it instead of waiting out the servers' next
+        # lease poll (the EPOCH_END frames trail by a backoff cycle)
+        with self._commit_lock:
+            if self._lease_client is not None:
+                resp = self._lease_client.done(
+                    self.epoch, shard, self.fileset
+                )
+                status = resp.get("status", "error")
+                if status not in ("recorded", "duplicate"):
+                    raise _CommitRefused(
+                        f"tracker refused shard_done for micro-shard "
+                        f"{shard} (epoch {self.epoch}): "
+                        f"{resp.get('error', resp)}"
+                    )
+                complete = bool(resp.get("epoch_complete"))
+            if status == "recorded":
+                self.shards_recorded += 1
+                for batch, seq in pending:
+                    if self.on_slot is not None:
+                        self.on_slot(shard, seq, batch.packed)
+                    if not self._put(("batch", batch)):
+                        return
+            else:
+                self.shards_duplicate += 1
+        if self.on_shard_done is not None:
+            self.on_shard_done(shard, status)
+        if complete:
+            self._put(("epoch_done",))
+
+    def _run_endpoint(self, i: int) -> None:
+        st = self._eps[i]
+        policy = RetryPolicy()
+        stalls = 0  # consecutive failed connect/stream cycles
+        try:
+            while not self._kill.is_set():
+                try:
+                    sock = self._connect(i, st.delivered)
+                except Exception as e:
+                    if not (is_transient(e) or isinstance(e, OSError)):
+                        raise
+                    stalls += 1
+                    st.reconnects += 1
+                    _RECONNECTS.inc()
+                    if stalls >= policy.max_attempts:
+                        raise
+                    policy.pause(cause=e, what=f"dsserve connect #{i}")
+                    continue
+                st.sock = sock
+                slots_before = st.slots
+                try:
+                    self._drain_stream(i, sock)
+                    return  # EPOCH_END
+                except (OSError, ConnectionError, Error) as e:
+                    if self._kill.is_set():
+                        return
+                    if isinstance(e, _CommitRefused) or not (
+                        is_transient(e) or isinstance(e, Error)
+                    ):
+                        raise
+                    if st.slots > slots_before:
+                        # real progress this cycle — like
+                        # RetryingReadStream, the cap bounds STUCK
+                        # retries, not total faults healed (a blanket
+                        # reset on a mere successful HELLO would make
+                        # the cap unreachable for a server that dies
+                        # deterministically after accepting)
+                        stalls = 0
+                    # partial-shard state died with the connection (a
+                    # crc mismatch or reset makes the stream unusable
+                    # from that byte on); lease mode re-serves via the
+                    # ledger, static mode resumes at the delivered count
+                    stalls += 1
+                    st.reconnects += 1
+                    _RECONNECTS.inc()
+                    if stalls >= policy.max_attempts:
+                        raise
+                    policy.pause(cause=e, what=f"dsserve stream #{i}")
+                finally:
+                    st.sock = None
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+        except Exception as e:  # terminal for this endpoint
+            st.dead = True
+            self._put(("err", e, i))
+        finally:
+            if not st.dead:
+                st.finished = True
+                self._put(("end", i))
+
+    def _drain_stream(self, i: int, sock) -> None:
+        """Pump one connection until EPOCH_END. Lease-mode slots buffer
+        per shard until SHARD_FIN commits them (a FIN with zero slots
+        is a legitimately EMPTY micro-shard and is committed too);
+        static-mode slots deliver immediately (their stripe is
+        exclusively this endpoint's, the delivered count is the resume
+        point)."""
+        st = self._eps[i]
+        pending: List = []
+        pending_shard: Optional[int] = None
+        while not self._kill.is_set():
+            kind, meta, payload, seq, _epoch = wire.recv_frame(sock)
+            if kind == wire.KIND_SLOT:
+                batch = wire.read_batch(meta, payload)
+                shard = int(meta.get("shard", -1))
+                st.slots += 1
+                st.bytes += payload.nbytes
+                if self.mode == "lease":
+                    if pending_shard is None:
+                        pending_shard = shard
+                    elif shard != pending_shard:
+                        raise Error(
+                            f"dsserve: interleaved shards on one stream "
+                            f"({pending_shard} then {shard})"
+                        )
+                    pending.append((batch, seq))
+                else:
+                    if self.on_slot is not None:
+                        self.on_slot(shard, seq, batch.packed)
+                    if not self._put(("batch", batch)):
+                        return
+                    st.delivered += 1
+            elif kind == wire.KIND_SHARD_FIN:
+                shard = int(meta.get("shard", -1))
+                if self.mode == "lease":
+                    if pending_shard is not None and shard != pending_shard:
+                        raise Error(
+                            f"dsserve: SHARD_FIN for {shard} while shard "
+                            f"{pending_shard} is in flight"
+                        )
+                    self._commit_shard(shard, pending)
+                pending = []
+                pending_shard = None
+            elif kind == wire.KIND_EPOCH_END:
+                return
+            elif kind == wire.KIND_ERROR:
+                raise Error(
+                    f"dsserve server error: {meta.get('error', meta)!r}"
+                )
+            else:
+                raise Error(f"dsserve: unexpected frame kind {kind}")
+
+    # -- producer contract ---------------------------------------------------
+    def __iter__(self) -> Iterator[Batch]:
+        """Interleave delivered slots from every endpoint; ends when
+        every endpoint thread reported end-of-epoch or terminal
+        failure. Lease mode tolerates dead endpoints as long as at
+        least one stream saw EPOCH_END (the ledger re-served the dead
+        stream's shards — that IS the failover); static mode cannot
+        (a stripe has exactly one home without a ledger)."""
+        check(
+            not getattr(self, "_iterated", False),
+            "DsServeBatches is a one-epoch stream: construct a new "
+            "instance (epoch=N) for the next epoch",
+        )
+        self._iterated = True
+        ended = 0
+        errors: List = []
+        while ended < len(self.endpoints):
+            t0 = time.perf_counter()
+            with annotate("dmlc:dsserve_recv_wait"):
+                item = self._out.get()
+            dt = time.perf_counter() - t0
+            self.recv_wait_secs += dt
+            _RECV_WAIT.observe(dt)
+            if item[0] == "batch":
+                yield item[1]
+            elif item[0] == "epoch_done":
+                # the ledger is fully accounted and (by the commit-lock
+                # ordering) every delivered batch precedes this
+                # sentinel — don't wait out the streams' EPOCH_END
+                # frames; close() reaps the receiver threads
+                return
+            elif item[0] == "end":
+                ended += 1
+            else:  # ("err", exc, idx)
+                ended += 1
+                errors.append(item[1])
+        if errors:
+            finished = sum(1 for s in self._eps if s.finished)
+            if finished == 0:
+                raise Error(
+                    f"every dsserve endpoint failed: {errors[0]}"
+                ) from errors[0]
+            if self.mode == "static":
+                raise Error(
+                    "dsserve static stripe lost (no failover without a "
+                    f"tracker): {errors[0]}"
+                ) from errors[0]
+
+    def io_stats(self) -> Dict[str, object]:
+        return {
+            "mode": f"dsserve:{self.mode}",
+            "endpoints": len(self.endpoints),
+            "endpoints_dead": sum(1 for s in self._eps if s.dead),
+            "slots": sum(s.slots for s in self._eps),
+            "bytes_recv": sum(s.bytes for s in self._eps),
+            "reconnects": sum(s.reconnects for s in self._eps),
+            "shards_recorded": self.shards_recorded,
+            "shards_duplicate": self.shards_duplicate,
+            "recv_wait_secs": round(self.recv_wait_secs, 4),
+        }
+
+    def close(self) -> None:
+        self._kill.set()
+        # break receivers out of a blocking recv (a parked stream —
+        # e.g. its server waiting out a lease backoff — never notices
+        # the kill flag otherwise)
+        for st in self._eps:
+            sock = st.sock
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+        # unblock any receiver parked in a bounded put
+        while True:
+            try:
+                self._out.get_nowait()
+            except queue.Empty:
+                break
+        for t in self._threads:
+            t.join(timeout=2.0)

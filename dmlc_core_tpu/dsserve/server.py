@@ -1,0 +1,564 @@
+"""dsserve server: a standalone preprocessing worker streaming packed slots.
+
+One :class:`DsServeServer` process runs the repo's existing
+fetch→decode→gather-parse→pack pipeline (staging/fused.py producers —
+the same code the trainer would run locally) and serves the finished
+packed slots to connected trainers over the wire framing
+(dsserve/wire.py). Per client stream:
+
+- **lease mode** (a tracker is running): the server is a plain PR-10
+  leaseholder — it pulls micro-shard leases from the tracker's shard
+  service (``ShardLeaseClient``), opens the standard per-shard producer
+  (bit-identical shard content: a micro-shard IS ``(part_index=i,
+  num_parts=M)`` of the static planner), streams each produced slot,
+  and marks the shard's stream complete with a SHARD_FIN frame. It
+  never calls ``shard_done`` — the CLIENT commits, so delivery and
+  exactly-once accounting are the same decision and a server killed
+  after streaming-but-before-commit costs nothing but a lease TTL
+  (docs/dsserve.md "commit protocol").
+- **static mode** (no tracker): the HELLO pins ``(part, nparts)`` and
+  an optional ``start_seq`` — the reopen-and-seek resume point: the
+  deterministic producer is re-run and the first ``start_seq`` slots
+  are skipped, the streaming analogue of ``RetryingReadStream``'s
+  reopen-at-offset.
+
+Production overlaps the socket send through a bounded ThreadedIter
+(``DMLC_DSSERVE_QUEUE`` slots ahead), observable as the
+``dsserve.queue_depth`` gauge; ``dsserve.{slots_served,bytes,clients}``
+count the serving side (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..concurrency.threaded_iter import ThreadedIter
+from ..staging.batcher import BatchSpec
+from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
+from ..tracker.protocol import make_listener
+from ..utils.logging import Error
+from ..utils.profiler import annotate
+from . import wire
+
+__all__ = ["DsServeServer", "default_queue_depth"]
+
+logger = logging.getLogger("dmlc_core_tpu.dsserve")
+
+_REG = _default_registry()
+_SLOTS = _REG.counter(
+    "dsserve.slots_served", help="packed slots streamed to clients"
+)
+_BYTES = _REG.counter(
+    "dsserve.bytes", help="packed payload bytes streamed to clients"
+)
+_CLIENTS = _REG.gauge(
+    "dsserve.clients", help="live client stream connections"
+)
+_QDEPTH = _REG.gauge(
+    "dsserve.queue_depth", help="produced-but-unsent slots (all streams)"
+)
+
+
+def default_queue_depth() -> int:
+    """``DMLC_DSSERVE_QUEUE`` (default 4): slots produced ahead of the
+    socket send per stream. Bounded well inside the producer ring
+    (``ring_slots`` ≥ depth + 3) so a slot is never recycled while it
+    sits unsent."""
+    try:
+        return max(1, int(os.environ.get("DMLC_DSSERVE_QUEUE", "4")))
+    except ValueError:
+        return 4
+
+
+def default_send_timeout() -> float:
+    """``DMLC_DSSERVE_SEND_TIMEOUT`` seconds (default 300): how long a
+    slot send may block before the stream is failed loudly. TCP never
+    errors against a live-but-paused peer (SIGSTOP'd trainer, full
+    receive buffer), so without a deadline a stalled client wedges the
+    stream thread, its producer and its buffered slots forever on a
+    long-lived shared tier — the RabitWorker link-deadline idiom
+    applied to the serving side. Teardown releases the stream's leases,
+    so a failed stream costs the stalled client a reconnect, never the
+    epoch."""
+    try:
+        return max(
+            1.0, float(os.environ.get("DMLC_DSSERVE_SEND_TIMEOUT", "300"))
+        )
+    except ValueError:
+        return 300.0
+
+
+def _uri_with_epoch(uri: str, epoch: int) -> str:
+    """Thread the stream's epoch into the dataset URI sugar (indexed
+    sources resolve ``?epoch=E`` to the epoch's deterministic shuffle
+    permutation; sequential sources are epoch-invariant)."""
+    if epoch <= 0 or "index=" not in uri:
+        return uri
+    head, sep, frag = uri.partition("#")
+    head += ("&" if "?" in head else "?") + f"epoch={int(epoch)}"
+    return head + sep + frag
+
+
+class _StreamConfig:
+    """Validated HELLO payload → producer construction arguments."""
+
+    def __init__(self, meta: Dict) -> None:
+        try:
+            self.uri = str(meta["uri"])
+            spec = dict(meta["spec"])
+            self.layout = str(spec.get("layout", "ell"))
+            self.spec = BatchSpec(
+                batch_size=int(spec["batch_size"]),
+                layout=self.layout,
+                max_nnz=spec.get("max_nnz"),
+                num_features=spec.get("num_features"),
+                overflow=str(spec.get("overflow", "truncate")),
+                index_dtype=np.dtype(spec.get("index_dtype", "int32")),
+                value_dtype=np.dtype(spec.get("value_dtype", "float32")),
+            )
+            self.format = str(meta.get("format", "auto"))
+            self.epoch = int(meta.get("epoch", 0))
+            self.mode = str(meta.get("mode", "static"))
+            self.part = int(meta.get("part", 0))
+            self.nparts = int(meta.get("nparts", 1))
+            self.start_seq = int(meta.get("start_seq", 0))
+            self.fileset = meta.get("fileset")
+        except (KeyError, TypeError, ValueError) as e:
+            raise Error(f"dsserve: bad HELLO config: {e}") from e
+        if self.mode not in ("lease", "static"):
+            raise Error(f"dsserve: unknown stream mode {self.mode!r}")
+        if self.mode == "static" and not (
+            0 <= self.part < self.nparts and self.start_seq >= 0
+        ):
+            raise Error(
+                f"dsserve: bad static stripe ({self.part}, {self.nparts}, "
+                f"start_seq={self.start_seq})"
+            )
+
+    def make_producer(self, part: int, nparts: int):
+        """The standard local producer for one (micro-)shard — exactly
+        what the trainer would build, so slot bytes are bit-identical
+        by construction (epoch rides the URI sugar). A local dataset
+        OSError (typo'd path in the HELLO URI) becomes a checked Error
+        so it takes the ERROR-frame path to the client instead of the
+        client-disconnected log branch — the trainer must see "no such
+        file", not an opaque connection reset."""
+        from ..staging import fused
+
+        uri = _uri_with_epoch(self.uri, self.epoch)
+        try:
+            if self.layout == "dense":
+                return fused.dense_batches(
+                    uri, self.spec, part, nparts, format=self.format
+                )
+            return fused.ell_batches(
+                uri, self.spec, part, nparts, format=self.format
+            )
+        except OSError as e:
+            raise Error(
+                f"dsserve: cannot open dataset {self.uri!r}: {e}"
+            ) from e
+
+
+class DsServeServer:
+    """One preprocessing worker: TCP listener + one thread per client
+    stream. ``start()`` serves in the background (in-process tests /
+    diag); ``serve_forever()`` is the CLI foreground mode; ``close()``
+    tears the listener and waits briefly for stream threads."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rank: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+    ) -> None:
+        self._sock = make_listener(host, port)
+        self.host = host
+        self.port = int(self._sock.getsockname()[1])
+        # lease identity: the launcher's task id for the tier
+        # (dmlc-submit --dsserve exports DMLC_TASK_ID per server); any
+        # rank >= 0 may lease — the ledger's elastic-join contract
+        if rank is None:
+            try:
+                rank = int(os.environ.get("DMLC_TASK_ID", "0"))
+            except ValueError:
+                rank = 0
+        self.rank = rank
+        self._queue_depth = (
+            queue_depth if queue_depth else default_queue_depth()
+        )
+        # seeded-chaos hook (the io/faults.py + collective kill_seq
+        # idiom): SIGKILL this process after N streamed slots — always
+        # mid-shard for any N not on a shard boundary, so the chaos
+        # drill strands an in-flight lease deterministically
+        try:
+            self._kill_after = int(
+                os.environ.get("DMLC_DSSERVE_KILL_AFTER_SLOTS", "0") or 0
+            )
+        except ValueError:
+            self._kill_after = 0
+        self._closed = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._streams: list = []
+        self._depth_lock = threading.Lock()
+        self._depth = 0
+        # serving-side shape (mirrored by the registry series)
+        self.slots_served = 0
+        self.bytes_served = 0
+        self.shards_streamed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "DsServeServer":
+        t = threading.Thread(
+            target=self._accept_loop, daemon=True, name="dsserve-accept"
+        )
+        self._accept_thread = t
+        t.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._accept_loop()
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for t in list(self._streams):
+            t.join(timeout=2.0)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+
+    # -- accept + stream -----------------------------------------------------
+    def _accept_loop(self) -> None:
+        # a timed accept keeps close() prompt: closing a listening
+        # socket from another thread does not reliably unblock a
+        # blocked accept(), so the loop polls the closed flag instead
+        self._sock.settimeout(0.25)
+        while not self._closed.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed
+            conn.settimeout(None)
+            t = threading.Thread(
+                target=self._serve_client,
+                args=(conn, addr),
+                daemon=True,
+                name="dsserve-stream",
+            )
+            # prune finished streams so a long-lived server's roster
+            # doesn't grow one entry per connection forever
+            self._streams = [s for s in self._streams if s.is_alive()]
+            self._streams.append(t)
+            t.start()
+
+    def _tick_depth(self, d: int) -> None:
+        with self._depth_lock:
+            self._depth += d
+            _QDEPTH.set(self._depth)
+
+    def _serve_client(self, conn, addr) -> None:
+        _CLIENTS.inc()
+        try:
+            conn.settimeout(30.0)
+            kind, meta, _payload, _seq, _ep = wire.recv_frame(conn)
+            if kind != wire.KIND_HELLO:
+                raise Error(f"dsserve: expected HELLO, got frame kind {kind}")
+            cfg = _StreamConfig(meta)
+            # a deadline, not None: a stalled (not disconnected) client
+            # must fail the stream loudly instead of wedging it forever
+            conn.settimeout(default_send_timeout())
+            wire.send_frame(
+                conn, wire.KIND_OK,
+                {"mode": cfg.mode, "rank": self.rank, "pid": os.getpid()},
+            )
+            if cfg.mode == "lease":
+                self._stream_leased(conn, cfg)
+            else:
+                self._stream_static(conn, cfg)
+        except (Error, ValueError, KeyError) as e:
+            logger.warning("dsserve stream from %s failed: %s", addr, e)
+            try:
+                conn.settimeout(5.0)
+                wire.send_frame(conn, wire.KIND_ERROR, {"error": str(e)})
+            except (OSError, Error):
+                pass
+        except (OSError, ConnectionError) as e:
+            # client went away mid-stream: normal during failover/close
+            logger.info("dsserve client %s disconnected: %s", addr, e)
+        finally:
+            _CLIENTS.dec()
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send_slots(
+        self, conn, producer, shard: int, epoch: int, seq0: int,
+        skip: int = 0,
+    ) -> int:
+        """Stream one producer's batches as SLOT frames; returns the
+        next seq (the static-mode path). Production runs
+        ``queue_depth`` slots ahead of the socket send on a
+        ThreadedIter (decode/parse overlaps the network write);
+        ``skip`` drops the first N batches without sending — the
+        deterministic resume seek."""
+        ring = getattr(producer, "ring_slots", None)
+        depth = self._queue_depth
+        if ring is not None:
+            # a yielded batch is valid until ring_slots - 1 further
+            # batches exist; in flight here = queue + producer hand +
+            # the one being sent
+            depth = max(1, min(depth, int(ring) - 3))
+
+        produced = [0]
+
+        def _counted():
+            for b in producer:
+                produced[0] += 1
+                self._tick_depth(1)
+                yield b
+
+        it: ThreadedIter = ThreadedIter(
+            _counted, max_capacity=depth, name="dsserve-produce"
+        )
+        seq = seq0
+        taken = 0
+        skipped = 0
+        try:
+            while True:
+                batch = it.next()
+                if batch is None:
+                    return seq
+                self._tick_depth(-1)
+                taken += 1
+                if skipped < skip:
+                    skipped += 1
+                    seq += 1
+                    continue
+                seq = self._send_one(conn, batch, shard, epoch, seq)
+        finally:
+            it.destroy(timeout=1.0)
+            # rewind the gauge by the discarded produced-but-untaken
+            # slots (see the leased path's teardown note)
+            self._tick_depth(taken - produced[0])
+
+    def _send_one(self, conn, batch, shard: int, epoch: int, seq: int) -> int:
+        meta = wire.slot_meta(batch, shard)
+        sent = wire.send_frame(
+            conn, wire.KIND_SLOT, meta, batch.packed, seq=seq, epoch=epoch
+        )
+        self.slots_served += 1
+        self.bytes_served += sent
+        _SLOTS.inc()
+        _BYTES.inc(sent)
+        if self._kill_after and self.slots_served >= self._kill_after:
+            os._exit(9)  # chaos drill: die mid-stream, no cleanup
+        return seq + 1
+
+    def _stream_static(self, conn, cfg: _StreamConfig) -> None:
+        """Tracker-less stripe: the deterministic whole-stripe stream,
+        resumable at any slot via HELLO.start_seq."""
+        producer = cfg.make_producer(cfg.part, cfg.nparts)
+        try:
+            with _tracing.span(
+                "dmlc:dsserve_stream_shard", shard=cfg.part, mode="static"
+            ):
+                seq = self._send_slots(
+                    conn, producer, cfg.part, cfg.epoch, 0,
+                    skip=cfg.start_seq,
+                )
+            self.shards_streamed += 1
+            wire.send_frame(
+                conn, wire.KIND_SHARD_FIN,
+                {"shard": cfg.part, "slots": seq},
+                epoch=cfg.epoch,
+            )
+            wire.send_frame(
+                conn, wire.KIND_EPOCH_END, {"slots": seq}, epoch=cfg.epoch
+            )
+        finally:
+            producer.close()
+
+    def _stream_leased(self, conn, cfg: _StreamConfig) -> None:
+        """PR-10 leaseholder loop: lease → produce → stream → SHARD_FIN
+        until the epoch's ledger drains. The client commits dones; this
+        side only keeps its leases renewed while it streams.
+
+        The lease loop, producer construction AND parsing all run on
+        ONE producer-ahead thread chained through a single bounded
+        ThreadedIter, so the next shard's lease round-trip, splitter
+        construction and first-window decode overlap the socket sends
+        of the previous shard's slots — without this, every shard
+        boundary is a serial bubble on the serving core."""
+        from ..tracker.shardsvc import ShardLeaseClient
+
+        try:
+            lease_client = ShardLeaseClient(rank=self.rank)
+        except KeyError as e:
+            raise Error(
+                "dsserve lease mode needs a tracker: set DMLC_TRACKER_URI/"
+                f"DMLC_TRACKER_PORT (missing {e})"
+            ) from None
+        epoch = cfg.epoch
+        # every shard this stream ever leased (granted on the producer
+        # thread; GIL-atomic set ops). Teardown releases them ALL —
+        # including FIN'd-but-uncommitted ones: the commit belongs to
+        # the client, so a client that died between receiving FIN and
+        # its shard_done leaves a lease this server's rank-wide renews
+        # (another stream of the same rank) would otherwise keep alive
+        # forever. Releasing an already-committed shard is a ledger
+        # no-op, so the clean end of an epoch costs only cheap RPCs.
+        leased: set = set()
+        state = {"ttl": 30.0, "last_renew": 0.0}
+        produced = [0]  # producer-thread slot ticks (gauge rewind)
+        # queue + producer hand + the slot being sent must stay under
+        # the producer's ring_slots - 1 (a yielded batch is only valid
+        # until that many further batches exist); producers are built
+        # inside the generator, so the bound is enforced there per
+        # producer — loudly, never by silently corrupting slot bytes
+        capacity = min(self._queue_depth, 7)
+
+        def _check_ring(producer) -> None:
+            ring = getattr(producer, "ring_slots", None)
+            if ring is not None and int(ring) - 3 < capacity:
+                raise Error(
+                    f"dsserve stream queue ({capacity}) does not fit the "
+                    f"producer ring ({ring} slots): lower "
+                    "DMLC_DSSERVE_QUEUE or deepen the producer ring"
+                )
+
+        def _produce():
+            while True:
+                resp = lease_client.lease(epoch, cfg.fileset)
+                status = resp.get("status")
+                if status == "lease":
+                    shard = int(resp["shard"])
+                    num_shards = int(resp["num_shards"])
+                    leased.add(shard)
+                    state["ttl"] = float(resp.get("ttl", 30.0))
+                    state["last_renew"] = time.monotonic()
+                    producer = cfg.make_producer(shard, num_shards)
+                    _check_ring(producer)
+                    try:
+                        with _tracing.span(
+                            "dmlc:dsserve_stream_shard", shard=shard,
+                            epoch=epoch,
+                        ):
+                            for batch in producer:
+                                produced[0] += 1
+                                self._tick_depth(1)
+                                yield ("slot", shard, batch)
+                    finally:
+                        producer.close()
+                    yield ("fin", shard, num_shards)
+                elif status == "wait":
+                    # cap below the worker-side 1.0s: an idle stream's
+                    # poll cadence gates how fast a reclaimed shard is
+                    # picked up and how fast end-of-epoch is noticed
+                    backoff = float(resp.get("backoff", 0.1))
+                    with annotate("dmlc:shard_lease_wait"):
+                        time.sleep(min(0.25, max(0.01, backoff)))
+                elif status == "done":
+                    yield ("epoch_end",)
+                    return
+                else:
+                    raise Error(
+                        "dsserve: shard lease failed: "
+                        f"{resp.get('error', resp)!r}"
+                    )
+
+        it: ThreadedIter = ThreadedIter(
+            _produce, max_capacity=capacity, name="dsserve-produce"
+        )
+        seq = 0
+        sent = 0
+        try:
+            while True:
+                item = it.next()
+                if item is None:
+                    return
+                kind = item[0]
+                if kind == "slot":
+                    _k, shard, batch = item
+                    self._tick_depth(-1)
+                    sent += 1
+                    seq = self._send_one(conn, batch, shard, epoch, seq)
+                    self._maybe_renew(lease_client, epoch, state)
+                elif kind == "fin":
+                    _k, shard, num_shards = item
+                    self.shards_streamed += 1
+                    wire.send_frame(
+                        conn, wire.KIND_SHARD_FIN,
+                        {"shard": shard, "num_shards": num_shards},
+                        seq=seq, epoch=epoch,
+                    )
+                else:  # epoch_end
+                    wire.send_frame(
+                        conn, wire.KIND_EPOCH_END, {"slots": seq},
+                        epoch=epoch,
+                    )
+                    return
+        finally:
+            it.destroy(timeout=1.0)
+            # rewind the queue-depth gauge by the produced-but-unsent
+            # slots the teardown just discarded, or every failover
+            # would ratchet the gauge permanently upward (one late
+            # in-hand tick from an orphaned producer can leave ±1,
+            # never unbounded drift)
+            self._tick_depth(sent - produced[0])
+            # every lease this stream took goes back to the queue NOW
+            # — including FIN'd shards whose commit never landed (dead
+            # client): rank-wide renews from sibling streams would
+            # otherwise keep an abandoned lease alive forever, and
+            # releasing a committed shard is a no-op
+            for shard in sorted(leased):
+                try:
+                    lease_client.release(epoch, shard, cfg.fileset)
+                except (OSError, ConnectionError):
+                    pass
+
+    @staticmethod
+    def _maybe_renew(lease_client, epoch: int, state: Dict) -> None:
+        now = time.monotonic()
+        if now - state["last_renew"] >= state["ttl"] / 3.0:
+            state["last_renew"] = now
+            try:
+                lease_client.renew(epoch)
+            except (OSError, ConnectionError):
+                pass  # next cadence retries; the TTL covers the gap
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        return {
+            "slots_served": self.slots_served,
+            "bytes_served": self.bytes_served,
+            "shards_streamed": self.shards_streamed,
+            "queue_depth": self._depth,
+            "rank": self.rank,
+            "port": self.port,
+        }
+
+
+def write_port_file(path: str, host: str, port: int) -> None:
+    """Atomic readiness signal for launchers (``dmlc-submit --dsserve``
+    polls for this file): one JSON line naming the bound endpoint."""
+    tmp = path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"host": host, "port": int(port)}, f)
+    os.replace(tmp, path)

@@ -1,0 +1,223 @@
+"""dsserve wire format: length-prefixed slot frames (lint L015 site).
+
+One frame = a fixed 32-byte header, a compact-JSON meta blob, and an
+optional raw payload (the packed-slot bytes, staged verbatim):
+
+    magic u32 | kind u8 | flags u8 | reserved u16 | seq i64 | epoch i32
+    | meta_len u32 | payload_len u32 | crc32(payload) u32
+
+riding the repo's length-prefixed framing idiom (tracker/protocol.py's
+int+string frames; io/blockcache.py's 4-byte-LE JSON control plane) at
+binary-payload scale. The header — and therefore every ``struct``
+pack/unpack of it — lives HERE and only here (lint L015, the
+L006-L014 single-site pattern): a second hand-rolled frame site could
+drift field order or endianness and corrupt every slot after it.
+
+Slot payloads are the exact ``alloc_packed_slot`` buffers the staging
+pipeline DMAs (staging/batcher.py): the SLOT meta carries the batch's
+``packed_layout`` descriptor — (name, offset, nbytes, shape, dtype)
+per section — plus ``n_valid`` and the serving micro-shard, so
+:func:`read_batch` rebuilds bit-identical numpy views over the
+received buffer with zero copies. ``crc32`` (payload only; the header
+is length-guarded) rejects torn frames at the receiver, where the
+client treats the connection as faulted and re-enters its
+reconnect/retry path (io/retry.py transient classification).
+"""
+
+from __future__ import annotations
+
+import binascii
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..staging.batcher import Batch
+from ..staging.pipeline import packed_layout
+from ..utils.logging import Error
+
+__all__ = [
+    "HDR_BYTES",
+    "KIND_EPOCH_END",
+    "KIND_ERROR",
+    "KIND_HELLO",
+    "KIND_OK",
+    "KIND_SHARD_FIN",
+    "KIND_SLOT",
+    "MAX_META",
+    "MAX_PAYLOAD",
+    "read_batch",
+    "recv_frame",
+    "send_frame",
+    "slot_meta",
+]
+
+MAGIC = 0x44535631  # "DSV1"
+
+#: header: magic u32, kind u8, flags u8, reserved u16, seq i64,
+#: epoch i32, meta_len u32, payload_len u32, crc32 u32 — 32 bytes
+_HDR = struct.Struct("<IBBHqiIII")
+HDR_BYTES = _HDR.size
+
+KIND_HELLO = 1      # client → server: ONE JSON stream-config frame
+KIND_OK = 2         # server → client: HELLO accepted (server info)
+KIND_SLOT = 3       # server → client: one packed batch slot
+KIND_SHARD_FIN = 4  # server → client: micro-shard fully streamed —
+#                     the CLIENT commits shard_done (docs/dsserve.md)
+KIND_EPOCH_END = 5  # server → client: the epoch's ledger drained
+KIND_ERROR = 6      # either direction: JSON {"error": ...}
+
+#: meta is config/layout JSON — anything bigger is hostile or corrupt
+MAX_META = 1 << 20
+#: one packed slot; mirrors the collective engine's 2 GiB frame cap
+MAX_PAYLOAD = (1 << 31) - 1
+
+
+def _recv_exact_into(sock, view: memoryview) -> None:
+    """Fill ``view`` from the socket or raise ConnectionError."""
+    got = 0
+    n = len(view)
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("dsserve peer closed mid-frame")
+        got += r
+
+
+def _recv_exact(sock, n: int) -> bytearray:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
+
+
+def send_frame(
+    sock,
+    kind: int,
+    meta: Optional[Dict] = None,
+    payload=None,
+    seq: int = 0,
+    epoch: int = 0,
+) -> int:
+    """Write one frame; returns payload bytes sent. ``payload`` is any
+    buffer-protocol object (numpy uint8 views included) sent without an
+    intermediate copy; the small header+meta pair is joined into one
+    ``sendall`` so a slot costs two syscalls, not three."""
+    mb = (
+        json.dumps(meta, separators=(",", ":")).encode()
+        if meta is not None
+        else b""
+    )
+    if len(mb) > MAX_META:
+        raise Error(f"dsserve meta too large ({len(mb)} bytes)")
+    pv = memoryview(payload).cast("B") if payload is not None else None
+    plen = len(pv) if pv is not None else 0
+    if plen > MAX_PAYLOAD:
+        raise Error(f"dsserve payload too large ({plen} bytes)")
+    crc = binascii.crc32(pv) & 0xFFFFFFFF if pv is not None else 0
+    hdr = _HDR.pack(
+        MAGIC, kind, 0, 0, int(seq), int(epoch), len(mb), plen, crc
+    )
+    sock.sendall(hdr + mb)
+    if pv is not None and plen:
+        sock.sendall(pv)
+    return plen
+
+
+def recv_frame(sock) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
+    """Read one frame → (kind, meta, payload, seq, epoch).
+
+    The payload lands in a freshly allocated uint8 array via
+    ``recv_into`` — one kernel→user copy, zero further copies before
+    the staging pipeline's dispatch-ring pack. Bad magic, hostile
+    lengths and crc mismatches raise ``Error`` (the connection is
+    unusable from that byte on — callers drop it and re-enter their
+    reconnect path)."""
+    hdr = _recv_exact(sock, HDR_BYTES)
+    magic, kind, _flags, _rsv, seq, epoch, mlen, plen, crc = _HDR.unpack(
+        bytes(hdr)
+    )
+    if magic != MAGIC:
+        raise Error(f"dsserve: bad frame magic {magic:#x}")
+    if mlen > MAX_META or plen > MAX_PAYLOAD:
+        raise Error(
+            f"dsserve: hostile frame lengths (meta={mlen}, payload={plen})"
+        )
+    meta: Dict = {}
+    if mlen:
+        try:
+            meta = json.loads(bytes(_recv_exact(sock, mlen)))
+        except ValueError as e:
+            raise Error(f"dsserve: undecodable frame meta: {e}") from e
+        if not isinstance(meta, dict):
+            raise Error("dsserve: frame meta must be a JSON object")
+    payload = None
+    if plen:
+        payload = np.empty(plen, dtype=np.uint8)
+        _recv_exact_into(sock, memoryview(payload))
+        got = binascii.crc32(memoryview(payload)) & 0xFFFFFFFF
+        if got != crc:
+            raise Error(
+                f"dsserve: slot crc mismatch (got {got:#x}, want {crc:#x})"
+            )
+    return kind, meta, payload, seq, epoch
+
+
+# -- packed-slot (de)serialization --------------------------------------------
+
+
+def slot_meta(batch: Batch, shard: int) -> Dict:
+    """SLOT meta for a producer batch: the ``packed_layout`` descriptor
+    + ``n_valid`` + serving micro-shard. Raises when the batch has no
+    usable packed layout — every repo producer (fused rings and the
+    generic FixedShapeBatcher alike) emits single-buffer batches, so a
+    non-packed batch here is a producer bug, not a fallback case."""
+    layout = packed_layout(batch)
+    if layout is None:
+        raise Error(
+            "dsserve can only serve packed single-buffer batches "
+            "(Batch.packed with contiguous section views)"
+        )
+    return {
+        "shard": int(shard),
+        "n_valid": int(batch.n_valid),
+        "sections": [
+            [name, int(off), int(nb), list(shape), dtype]
+            for name, off, nb, shape, dtype in layout
+        ],
+    }
+
+
+def read_batch(meta: Dict, payload: np.ndarray) -> Batch:
+    """Rebuild a Batch over the received payload buffer: zero-copy
+    views per the SLOT meta's section descriptors — byte-for-byte the
+    producer's ``alloc_packed_slot`` layout, so the staging pipeline's
+    packed single-DMA / packed-shard paths engage exactly as they
+    would for a local producer."""
+    fields: Dict[str, np.ndarray] = {}
+    try:
+        n_valid = int(meta["n_valid"])
+        for name, off, nb, shape, dtype in meta["sections"]:
+            if off < 0 or off + nb > payload.nbytes:
+                raise Error(
+                    f"dsserve: section {name!r} [{off},{off + nb}) outside "
+                    f"the {payload.nbytes}-byte slot payload"
+                )
+            fields[str(name)] = (
+                payload[off : off + nb].view(np.dtype(dtype)).reshape(shape)
+            )
+    except (KeyError, TypeError, ValueError) as e:
+        raise Error(f"dsserve: malformed slot meta: {e}") from e
+    for req in ("labels", "weights"):
+        if req not in fields:
+            raise Error(f"dsserve: slot meta missing section {req!r}")
+    return Batch(
+        labels=fields["labels"],
+        weights=fields["weights"],
+        n_valid=n_valid,
+        indices=fields.get("indices"),
+        values=fields.get("values"),
+        nnz=fields.get("nnz"),
+        x=fields.get("x"),
+        packed=payload,
+    )

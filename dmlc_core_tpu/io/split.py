@@ -63,6 +63,7 @@ __all__ = [
     "InputSplitShuffle",
     "DynamicShardSource",
     "create",
+    "fileset_signature",
     "normalize_shuffle",
     "plan_coalesced_spans",
 ]
@@ -2609,6 +2610,35 @@ class DynamicShardSource(InputSplit):
             self._probe = None
 
 
+def fileset_signature(
+    data_uri: str, index_uri: Optional[str] = None, type: str = "recordio"
+) -> str:
+    """Canonical dataset identity for the shard-lease protocol
+    (docs/sharding.md): mismatched workers (different URIs on the same
+    tracker) must fail loudly, not drain different bytes. fault://
+    wrappers are normalized away — a chaos-wrapped worker reads the
+    SAME dataset as its clean peers — and local paths are canonicalized
+    the way ``faults.wrap_uri`` canonicalizes them (strip ``file://``,
+    lead with ``/``) so a clean ``file:///d/x.rec`` peer signs
+    identically to a faulted ``/d/x.rec`` one. Shared by the dynamic
+    create() path and the dsserve preprocessing tier (both lease and
+    commit under this signature, so they can never disagree)."""
+    from .faults import unwrap_uri as _unwrap
+
+    def _sig_norm(u: str) -> str:
+        u = _unwrap(u)
+        if u.startswith("file://"):
+            u = u[len("file://"):]
+        if u and "://" not in u and not u.startswith("/"):
+            u = "/" + u
+        return u
+
+    return hashlib.sha1(
+        f"{_sig_norm(data_uri)}|{_sig_norm(index_uri or '')}|{type}"
+        .encode()
+    ).hexdigest()
+
+
 def create(
     uri: str,
     part_index: int = 0,
@@ -2771,27 +2801,7 @@ def create(
             and shuffle in ("record", "batch", "window")
             and not legacy
         )
-        # dataset signature: mismatched workers (different URIs on the
-        # same tracker) must fail loudly, not drain different bytes.
-        # fault:// wrappers are normalized away — a chaos-wrapped worker
-        # reads the SAME dataset as its clean peers — and local paths
-        # are canonicalized the way wrap_uri canonicalizes them (strip
-        # file://, lead with /) so a clean file:///d/x.rec peer signs
-        # identically to a faulted /d/x.rec one
-        from .faults import unwrap_uri as _unwrap
-
-        def _sig_norm(u: str) -> str:
-            u = _unwrap(u)
-            if u.startswith("file://"):
-                u = u[len("file://"):]
-            if u and "://" not in u and not u.startswith("/"):
-                u = "/" + u
-            return u
-
-        sig = hashlib.sha1(
-            f"{_sig_norm(spec.uri)}|{_sig_norm(index_uri or '')}|{type}"
-            .encode()
-        ).hexdigest()
+        sig = fileset_signature(spec.uri, index_uri, type)
         try:
             from ..tracker.shardsvc import ShardLeaseClient
 

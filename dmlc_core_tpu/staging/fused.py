@@ -1164,6 +1164,20 @@ def ell_batches(
     (ShardedFusedBatches: interleaved sub-shard order, one padded tail
     per sub-shard).
     """
+    if uri.startswith("dsserve://"):
+        # remote preprocessing tier (dmlc_core_tpu/dsserve/): the
+        # servers run THIS factory for their shards; the trainer side
+        # only receives finished packed slots (docs/dsserve.md). The
+        # static shard args don't apply — striping is per endpoint /
+        # per tracker lease.
+        check(
+            part_index == 0 and num_parts == 1,
+            "dsserve:// sources stripe across servers (or tracker "
+            "leases), not part_index/num_parts",
+        )
+        from ..dsserve.client import DsServeBatches
+
+        return DsServeBatches(uri, spec, format=format)
     uspec = URISpec(uri, part_index, num_parts)
     if format == "auto":
         format = str(uspec.args.get("format", "rowrec"))
@@ -1304,6 +1318,16 @@ def dense_batches(
     ``?indexing_mode=`` on the URI). Either way the result is iterable
     and has ``.close()``.
     """
+    if uri.startswith("dsserve://"):
+        # remote preprocessing tier — see the ell_batches route
+        check(
+            part_index == 0 and num_parts == 1,
+            "dsserve:// sources stripe across servers (or tracker "
+            "leases), not part_index/num_parts",
+        )
+        from ..dsserve.client import DsServeBatches
+
+        return DsServeBatches(uri, spec, format=format)
     uspec = URISpec(uri, part_index, num_parts)
     if format == "auto":
         format = str(uspec.args.get("format", "libsvm"))

@@ -58,6 +58,7 @@ __all__ = [
     "StagingStats",
     "device_put",
     "drain_close",
+    "packed_layout",
     "stage_batch",
     "unpack_cache_stats",
 ]
@@ -209,6 +210,15 @@ def _packed_layout(batch: Batch):
             return None
         layout.append((k, lo - base, v.nbytes, v.shape, str(v.dtype)))
     return tuple(layout)
+
+
+def packed_layout(batch: Batch):
+    """Public name for :func:`_packed_layout`: the exact
+    (name, offset, nbytes, shape, dtype) byte layout of a packed batch,
+    or None when the batch cannot ride a single-buffer path. The dsserve
+    wire (dmlc_core_tpu/dsserve/wire.py) ships this descriptor next to
+    the packed bytes so a remote consumer rebuilds bit-identical views."""
+    return _packed_layout(batch)
 
 
 def _unpacker(layout, platform: str):

@@ -600,6 +600,10 @@ _WAIT_STAGES = frozenset(
         "allreduce_wait",     # collective round blocked on peer links —
                               # a straggling/dead peer, or recovery in
                               # flight (tracker/collective.py)
+        "dsserve_recv_wait",  # trainer starved by the remote
+                              # preprocessing tier: network-bound or
+                              # under-provisioned dsserve workers
+                              # (dmlc_core_tpu/dsserve/client.py)
         "slot_wait",
     }
 )

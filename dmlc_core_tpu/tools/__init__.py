@@ -371,6 +371,45 @@ def _cmd_cached(args) -> int:
     return 0
 
 
+def _cmd_dsserve(args) -> int:
+    """Operator surface for the disaggregated preprocessing tier
+    (dmlc_core_tpu/dsserve/, docs/dsserve.md):
+
+    - ``serve``: run one preprocessing worker in the foreground (what
+      ``dmlc-submit --dsserve N`` launches N of, next to the tracker)
+      until SIGINT/SIGTERM. With a tracker in the environment
+      (``DMLC_TRACKER_URI``/``PORT``) the server leases micro-shards;
+      ``--port-file`` writes the bound endpoint as a JSON readiness
+      signal for launchers; ``--port 0`` binds any free port.
+    """
+    import json
+    import signal
+
+    from ..dsserve.server import DsServeServer, write_port_file
+    from ..telemetry import tracing
+
+    tracing.set_process_label("dsserve-worker")
+    server = DsServeServer(args.host, args.port, rank=args.rank)
+    if args.port_file:
+        write_port_file(args.port_file, args.host, server.port)
+    signal.signal(signal.SIGTERM, lambda *_a: server.close())
+    print(
+        f"dsserve worker pid {os.getpid()} rank {server.rank} serving "
+        f"{args.host}:{server.port}"
+        + (" (tracker-leased shards)"
+           if os.environ.get("DMLC_TRACKER_URI") else " (static stripes)"),
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        print(json.dumps(server.stats()), file=sys.stderr)
+    return 0
+
+
 def _cmd_trace(args) -> int:
     """Operator surface for the flight recorder (telemetry/tracing.py,
     docs/observability.md):
@@ -653,6 +692,26 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="serve: loopback /metrics port (0 = off)",
     )
     cd.set_defaults(fn=_cmd_cached)
+
+    ds = sub.add_parser(
+        "dsserve", help="disaggregated preprocessing worker (dsserve://)"
+    )
+    ds.add_argument("action", choices=["serve"])
+    ds.add_argument("--host", default="127.0.0.1")
+    ds.add_argument(
+        "--port", default=0, type=int,
+        help="listen port (0 = any free port; see --port-file)",
+    )
+    ds.add_argument(
+        "--port-file", default="",
+        help="write the bound endpoint here as JSON once listening "
+             "(the dmlc-submit launcher's readiness signal)",
+    )
+    ds.add_argument(
+        "--rank", default=None, type=int,
+        help="shard-lease identity (default $DMLC_TASK_ID)",
+    )
+    ds.set_defaults(fn=_cmd_dsserve)
 
     tr = sub.add_parser(
         "trace", help="flight-recorder dump/merge/report (Perfetto)"

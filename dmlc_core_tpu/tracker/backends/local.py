@@ -13,6 +13,7 @@ path.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import shutil
@@ -69,6 +70,71 @@ class HostBlockCache:
         shutil.rmtree(self._sock_dir, ignore_errors=True)
 
 
+class DsServeTier:
+    """The job's disaggregated preprocessing tier (``dmlc-submit
+    --dsserve N``): N ``tools dsserve serve`` worker processes next to
+    the tracker, each leasing micro-shards from the job's shard service
+    (``envs`` carries the tracker address) and streaming packed slots.
+    Endpoints are collected from per-server port files and handed to
+    every worker as ``DMLC_DSSERVE`` so payloads can open
+    ``dsserve://$DMLC_DSSERVE/<dataset-uri>``; ``stop()`` tears the
+    tier down with the job. Lease identities start at task id 1000 so
+    they can never collide with trainer ranks (a collision would let a
+    trainer heartbeat renew a server's leases)."""
+
+    def __init__(
+        self, n: int, envs: Dict[str, object], host: str = "127.0.0.1"
+    ) -> None:
+        self._dir = tempfile.mkdtemp(prefix="dmlc-dsserve-")
+        self._procs: List[subprocess.Popen] = []
+        port_files = []
+        for i in range(n):
+            pf = os.path.join(self._dir, f"server{i}.port")
+            port_files.append(pf)
+            env = os.environ.copy()
+            for k, v in envs.items():
+                env[str(k)] = str(v)
+            env["DMLC_TASK_ID"] = str(1000 + i)
+            self._procs.append(subprocess.Popen([
+                sys.executable, "-m", "dmlc_core_tpu.tools", "dsserve",
+                "serve", "--host", host, "--port", "0",
+                "--port-file", pf,
+            ], env=env))
+        endpoints = []
+        deadline = time.monotonic() + 15.0
+        try:
+            for i, pf in enumerate(port_files):
+                while not os.path.exists(pf):
+                    if (self._procs[i].poll() is not None
+                            or time.monotonic() > deadline):
+                        raise RuntimeError(
+                            f"dsserve worker {i} failed to start "
+                            f"(port file {pf} never appeared)"
+                        )
+                    time.sleep(0.05)
+                with open(pf) as f:
+                    ep = json.load(f)
+                endpoints.append(f"{ep['host']}:{ep['port']}")
+        except BaseException:
+            self.stop()
+            raise
+        self.endpoints = ",".join(endpoints)
+        logger.info("dsserve tier serving at %s", self.endpoints)
+
+    def stop(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
 def make_launcher(
     cmd: List[str],
     nworker: int,
@@ -98,13 +164,17 @@ def make_launcher(
 def submit(args) -> None:
     checks: List = []
     cache: Optional[HostBlockCache] = None
+    dsserve: Optional[DsServeTier] = None
 
     def launch_all(nworker: int, nserver: int, envs: Dict[str, object]) -> None:
-        nonlocal cache
+        nonlocal cache, dsserve
         if args.dry_run:
             if getattr(args, "block_cache", False):
                 print("[dry-run] block-cache daemon: "
                       "python -m dmlc_core_tpu.tools cached serve")
+            for i in range(int(getattr(args, "dsserve", 0) or 0)):
+                print(f"[dry-run] dsserve worker {i}: "
+                      "python -m dmlc_core_tpu.tools dsserve serve")
             for i in range(nworker + nserver):
                 role = "worker" if i < nworker else "server"
                 print(f"[dry-run] local task {i} role={role}: "
@@ -114,6 +184,13 @@ def submit(args) -> None:
             cache = HostBlockCache(getattr(args, "block_cache_mb", 0))
             envs = dict(envs)
             envs["DMLC_BLOCK_CACHE_SOCK"] = cache.sock_path
+        if int(getattr(args, "dsserve", 0) or 0) > 0:
+            dsserve = DsServeTier(
+                int(args.dsserve), envs,
+                host=getattr(args, "dsserve_host", "127.0.0.1"),
+            )
+            envs = dict(envs)
+            envs["DMLC_DSSERVE"] = dsserve.endpoints
         # --local-num-attempt retries == max_attempt total runs - 1
         # (reference local.py retry budget); DMLC_MAX_ATTEMPT wins if set.
         # localhost is one shared host, not a failure domain — per-task
@@ -149,5 +226,7 @@ def submit(args) -> None:
             abort_check=lambda: checks[0]() if checks else None,
         )
     finally:
+        if dsserve is not None:
+            dsserve.stop()
         if cache is not None:
             cache.stop()

@@ -130,6 +130,21 @@ def get_opts(args: Optional[List[str]] = None):
              "default 30). Renewal rides worker pulls and metrics "
              "heartbeats.",
     )
+    # disaggregated preprocessing tier (dmlc_core_tpu/dsserve/,
+    # docs/dsserve.md): N standalone workers running fetch→decode→
+    # parse→pack next to the tracker, leasing micro-shards from the
+    # shard service and streaming finished packed slots to trainers
+    parser.add_argument(
+        "--dsserve", default=0, type=int,
+        help="Start N dsserve preprocessing workers beside the tracker "
+             "and export DMLC_DSSERVE=host:port,... to the workers, "
+             "who read via dsserve://$DMLC_DSSERVE/<dataset-uri> "
+             "(local backend; torn down with the job).",
+    )
+    parser.add_argument(
+        "--dsserve-host", default="127.0.0.1", type=str,
+        help="Bind/advertise address for the dsserve tier.",
+    )
     # flight-recorder tracing (telemetry/tracing.py): one trace file
     # per process of the job — workers, cache daemon, tracker — all
     # landing in one directory for `tools trace merge`

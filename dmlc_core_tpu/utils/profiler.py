@@ -32,6 +32,7 @@ a trace of a training loop shows exactly where infeed time goes
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 from contextlib import contextmanager, nullcontext
@@ -45,9 +46,17 @@ __all__ = ["annotate", "enable_histograms", "histograms_enabled", "trace"]
 _PROF = False  # unresolved sentinel; None = jax absent
 
 
-def _jax_profiler():
+def _jax_profiler(force: bool = False):
     global _PROF
     if _PROF is False:  # resolve once — annotate() sits on the hot loop
+        if not force and "jax" not in sys.modules:
+            # a process that never imported jax cannot have an active
+            # XProf trace, so don't pay the ~1s jax import just to
+            # annotate host-side spans (dsserve servers, bench drain
+            # workers, shard-lease drains are all jax-free); the
+            # sentinel stays unresolved, so a later jax import is
+            # picked up by the next annotate
+            return None
         try:
             import jax.profiler as prof  # deferred: works without jax
 
@@ -158,7 +167,7 @@ def trace(logdir: str):
     xprof CLI); host annotations from ``annotate`` appear on the host
     threads, device ops on the device timeline.
     """
-    prof = _jax_profiler()
+    prof = _jax_profiler(force=True)
     if prof is None:
         raise RuntimeError("profiler trace requires jax")
     prof.start_trace(logdir)

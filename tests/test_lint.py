@@ -472,6 +472,70 @@ def test_socket_construction_quiet_outside_scope_and_in_owners(tmp_path):
         "s = server.socket.accept()\n", tmp_path) == []
 
 
+def _dsserve_findings(src, tmp_path, name="mod.py"):
+    """Findings for a file living under dmlc_core_tpu/dsserve/ (the
+    L015 scope)."""
+    d = tmp_path / "dmlc_core_tpu" / "dsserve"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(src)
+    return [(code, line) for (_, line, code, _) in lint.lint_file(f)]
+
+
+def test_struct_framing_flagged_in_wire_scopes(tmp_path):
+    """L015: the dsserve slot-frame header (and every other binary wire
+    header in dsserve/ and tracker/) is packed/unpacked in exactly one
+    module per protocol — a second struct site can drift field order or
+    endianness and corrupt every frame after it."""
+    assert [c for c, _ in _dsserve_findings(
+        "import struct\nhdr = struct.pack('<IBq', 1, 2, 3)\n", tmp_path)
+    ] == ["L015"]
+    assert [c for c, _ in _dsserve_findings(
+        "import struct\nf = struct.unpack('<I', b'xxxx')\n", tmp_path)
+    ] == ["L015"]
+    assert [c for c, _ in _dsserve_findings(
+        "import struct as st\nh = st.Struct('<IBq')\n", tmp_path)
+    ] == ["L015"]
+    assert [c for c, _ in _dsserve_findings(
+        "from struct import pack as p\nh = p('<I', 1)\n", tmp_path)
+    ] == ["L015"]
+    # tracker/ is scoped too (its frames belong to protocol.py /
+    # collective.py)
+    assert [c for c, _ in _tracker_findings(
+        "import struct\nhdr = struct.pack('<i', 1)\n", tmp_path)
+    ] == ["L015"]
+    # per-line opt-out works like every other rule
+    assert _dsserve_findings(
+        "import struct\n"
+        "h = struct.pack('<I', 1)  # noqa: L015 (fixture)\n", tmp_path
+    ) == []
+
+
+def test_struct_framing_quiet_outside_scope_and_in_owners(tmp_path):
+    # recordio/codec/serializer frames live outside the scope — theirs
+    # are FILE formats, not wire protocols, and they own their headers
+    assert _lib_findings(
+        "import struct\nh = struct.pack('<II', 1, 2)\n", tmp_path) == []
+    # tests craft raw frames deliberately — out of scope
+    assert codes(
+        "import struct\nh = struct.pack('<I', 1)\n", tmp_path) == []
+    # the sanctioned wire modules are exempt
+    d = tmp_path / "dmlc_core_tpu" / "dsserve"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "wire.py"
+    f.write_text("import struct\nh = struct.Struct('<IBq')\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    dt = tmp_path / "dmlc_core_tpu" / "tracker"
+    dt.mkdir(parents=True, exist_ok=True)
+    for owner in ("protocol.py", "collective.py"):
+        f = dt / owner
+        f.write_text("import struct\nh = struct.pack('<i', 1)\n")
+        assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # struct-module references that are not pack/unpack calls are fine
+    assert _dsserve_findings(
+        "import struct\nn = struct.calcsize('<I')\n", tmp_path) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -88,6 +88,20 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  site. Genuine non-wire uses — the UDP
                                  route probe in get_host_ip — opt out
                                  per line with `# noqa: L014`.)
+  L015 struct frame pack/unpack in dmlc_core_tpu/dsserve/ and
+                                 dmlc_core_tpu/tracker/ (binary wire
+                                 framing is a single-site concern: the
+                                 dsserve slot-frame header lives in
+                                 dsserve/wire.py, the rendezvous int/
+                                 string frames in tracker/protocol.py,
+                                 the collective's peer-link header in
+                                 tracker/collective.py — those three
+                                 are exempt. A struct.pack/unpack/
+                                 Struct call elsewhere in either tree
+                                 hand-rolls a frame that can drift
+                                 field order or endianness against the
+                                 sanctioned sites and corrupt every
+                                 frame after it.)
   L012 thread-pool creation in dmlc_core_tpu/io/ (exactly two pools are
                                  sanctioned: codec.py's decode pool —
                                  sized by the cgroup/affinity-aware
@@ -387,6 +401,15 @@ _L013_EXEMPT = ("/tracker/protocol.py",)
 # collective.py (the peer-link data plane)
 _L014_SCOPE_DIRS = ("dmlc_core_tpu/tracker/",)
 _L014_EXEMPT = ("/tracker/protocol.py", "/tracker/collective.py")
+# L015 is scoped to the two trees that own binary wire protocols and
+# exempts their sanctioned frame sites (the dsserve slot framing, the
+# rendezvous int/string framing, the collective peer-link header)
+_L015_SCOPE_DIRS = ("dmlc_core_tpu/dsserve/", "dmlc_core_tpu/tracker/")
+_L015_EXEMPT = (
+    "/dsserve/wire.py",
+    "/tracker/protocol.py",
+    "/tracker/collective.py",
+)
 _L013_CMDS = frozenset(
     {
         "start",
@@ -569,6 +592,48 @@ def _check_socket_construction(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+_STRUCT_FNS = ("pack", "unpack", "pack_into", "unpack_from", "Struct")
+
+
+def _check_struct_framing(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call resolving to the struct module's pack/unpack/Struct —
+    ``struct.pack(...)`` under any module alias, or the bare names
+    bound by ``from struct import pack/Struct`` (with or without an
+    alias): inside dmlc_core_tpu/dsserve/ and dmlc_core_tpu/tracker/
+    the wire framing is a single-site concern (dsserve/wire.py's slot
+    frames, protocol.py's int/string frames, collective.py's peer-link
+    header), mirroring the L006/L008-L014 pattern — a second
+    hand-rolled frame site can drift field order or endianness and
+    corrupt every frame after it. Scoped in lint_file."""
+    fn_aliases = set()
+    mod_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "struct":
+            for alias in node.names:
+                if alias.name in _STRUCT_FNS:
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "struct":
+                    mod_aliases.add(alias.asname or "struct")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+            isinstance(f, ast.Attribute)
+            and f.attr in _STRUCT_FNS
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mod_aliases
+        )
+        if hit:
+            yield node.lineno, (
+                "struct frame pack/unpack outside the sanctioned wire "
+                "modules (dsserve frames belong to dsserve/wire.py; "
+                "tracker frames to protocol.py/collective.py)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -584,6 +649,7 @@ CHECKS = [
     ("L012", _check_thread_pool_creation),
     ("L013", _check_rendezvous_cmd_literals),
     ("L014", _check_socket_construction),
+    ("L015", _check_struct_framing),
 ]
 
 
@@ -665,6 +731,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L014_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L014_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L015":
+            if posix.endswith(_L015_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L015_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L015_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
