@@ -41,6 +41,7 @@ __all__ = [
     "parse_libsvm_ell",
     "shuffle_mt19937",
     "source_hash",
+    "walk_record_spans",
     "load",
 ]
 
@@ -52,6 +53,7 @@ HAS_GATHER_ELL = False  # shuffled-read (buf,starts,sizes)->ELL gather kernel
 HAS_LIBFM_ELL = False  # fused libfm->ELL-batch kernel present
 HAS_LIBSVM_ELL = False  # fused libsvm->ELL-batch kernel present
 HAS_SHUFFLE = False    # CPython-parity MT19937 Fisher-Yates kernel present
+HAS_WALK_SPANS = False  # batched point-read frame walk kernel present
 _LIB = None
 _LOCK = threading.Lock()
 
@@ -126,7 +128,7 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
     returns a fresh handle; the old one is left to the process lifetime).
     """
     global AVAILABLE, HAS_DENSE, HAS_ELL, HAS_CSV_DENSE, HAS_GATHER_ELL, \
-        HAS_LIBFM_ELL, HAS_LIBSVM_ELL, HAS_SHUFFLE, _LIB
+        HAS_LIBFM_ELL, HAS_LIBSVM_ELL, HAS_SHUFFLE, HAS_WALK_SPANS, _LIB
     with _LOCK:
         if _LIB is not None and not force:
             return AVAILABLE
@@ -134,7 +136,7 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
             _LIB = None
             AVAILABLE = HAS_DENSE = HAS_ELL = HAS_CSV_DENSE = False
             HAS_GATHER_ELL = HAS_LIBFM_ELL = HAS_LIBSVM_ELL = False
-            HAS_SHUFFLE = False
+            HAS_SHUFFLE = HAS_WALK_SPANS = False
         if os.environ.get("DMLC_TPU_NO_NATIVE", "0") == "1":
             return False
         paths = (path,) if path else _CANDIDATES
@@ -222,6 +224,15 @@ def load(path: Optional[str] = None, force: bool = False) -> bool:
                     ctypes.c_int32, ctypes.POINTER(_DenseResult)]
                 lib.dmlc_parse_libsvm_ell.restype = None
                 HAS_LIBSVM_ELL = True
+            # batched point-read frame walk: absent in older builds
+            if hasattr(lib, "dmlc_walk_record_spans"):
+                lib.dmlc_walk_record_spans.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.POINTER(ctypes.c_int64),
+                    ctypes.POINTER(ctypes.c_int64)]
+                lib.dmlc_walk_record_spans.restype = None
+                HAS_WALK_SPANS = True
             if hasattr(lib, "dmlc_source_hash"):
                 lib.dmlc_source_hash.restype = ctypes.c_char_p
                 lib.dmlc_source_hash.argtypes = []
@@ -580,6 +591,47 @@ def shuffle_mt19937(rnd, perm: np.ndarray) -> bool:
         ctypes.c_void_p(perm.ctypes.data),
     )
     return True
+
+
+def walk_record_spans(
+    buf: np.ndarray, starts: np.ndarray, sizes: np.ndarray
+) -> Optional[Tuple[np.ndarray, np.ndarray, int, int]]:
+    """Batched point-read frame walk (io/lookup.py): each
+    ``(starts[i], sizes[i])`` byte slice of ``buf`` must begin at a
+    RecordIO frame head; returns ``(payload_offs, payload_lens,
+    n_multipart, n_corrupt)`` with ``payload_offs[i]`` the record's
+    payload offset into ``buf`` for single-frame records, ``-2`` for a
+    multi-part chain (the caller reassembles those few in Python — the
+    payload is not a contiguous slice), ``-1`` for a slice that holds
+    no valid head (index/data mismatch; callers fail fast). One native
+    call per block in place of a per-record Python walk. None if the
+    kernel is missing."""
+    if not HAS_WALK_SPANS:
+        return None
+    from ..utils.logging import check
+
+    check(buf.flags.c_contiguous and buf.dtype == np.uint8,
+          "walk buf must be C-contiguous uint8")
+    check(starts.flags.c_contiguous and starts.dtype == np.int64
+          and sizes.flags.c_contiguous and sizes.dtype == np.int64
+          and len(sizes) == len(starts),
+          "starts/sizes must be matching C-contiguous int64")
+    n = len(starts)
+    out_off = np.empty(n, dtype=np.int64)
+    out_len = np.empty(n, dtype=np.int64)
+    nm = ctypes.c_int64()
+    nc = ctypes.c_int64()
+    _LIB.dmlc_walk_record_spans(
+        ctypes.c_void_p(buf.ctypes.data),
+        ctypes.c_void_p(starts.ctypes.data),
+        ctypes.c_void_p(sizes.ctypes.data),
+        ctypes.c_int64(n),
+        ctypes.c_void_p(out_off.ctypes.data),
+        ctypes.c_void_p(out_len.ctypes.data),
+        ctypes.byref(nm),
+        ctypes.byref(nc),
+    )
+    return out_off, out_len, int(nm.value), int(nc.value)
 
 
 def parse_libfm_ell(

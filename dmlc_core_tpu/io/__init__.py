@@ -49,6 +49,12 @@ from . import retry  # noqa: F401
 from . import faults  # noqa: F401 — registers the fault:// scheme
 from .retry import RetryPolicy, RetryingReadStream  # noqa: F401
 from .faults import FaultInjectingFileSystem  # noqa: F401
+from . import lookup  # noqa: F401 — the point-read hot path (L016)
+from .lookup import (  # noqa: F401
+    LookupClient,
+    LookupServer,
+    RecordLookup,
+)
 from .split import (  # noqa: F401
     InputSplit,
     InputSplitBase,

@@ -602,46 +602,14 @@ class BlockCacheDaemon:
 
 def _serve_daemon_metrics(daemon: "BlockCacheDaemon", port: int):
     """Daemon self-metrics: the process registry (io.blockcache.* per
-    tenant) rendered as Prometheus text on a loopback ``/metrics``."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    tenant) rendered as Prometheus text on a loopback ``/metrics``
+    (the shared single-process exporter, telemetry/export.py)."""
+    from ..telemetry.export import serve_metrics_http
 
-    from ..telemetry.export import to_prometheus
-
-    class _Handler(BaseHTTPRequestHandler):
-        def do_GET(self) -> None:  # noqa: N802 (http.server contract)
-            path = self.path.split("?", 1)[0]
-            try:
-                if path == "/metrics":
-                    body = to_prometheus(_REG.snapshot()).encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                elif path in ("/metrics.json", "/json", "/stats"):
-                    body = json.dumps(daemon.stats()).encode()
-                    ctype = "application/json"
-                else:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-            except Exception:
-                logger.exception("daemon metrics render failed")
-                self.send_response(500)
-                self.end_headers()
-                return
-            self.send_response(200)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, fmt: str, *args) -> None:
-            logger.debug("daemon metrics http: " + fmt, *args)
-
-    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-    server.daemon_threads = True
-    threading.Thread(
-        target=server.serve_forever, daemon=True,
+    return serve_metrics_http(
+        port, registry=_REG, json_provider=daemon.stats,
         name="blockcache-metrics-http",
-    ).start()
-    return server
+    )
 
 
 # -- client -------------------------------------------------------------------

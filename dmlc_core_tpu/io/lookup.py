@@ -1,0 +1,936 @@
+"""Low-latency batched point reads over indexed RecordIO — the online
+feature-store scenario (ROADMAP item 5, docs/serving.md).
+
+Every other consumer in this repo drains epochs; this module composes
+the already-built random-access substrate into a read-few-records-NOW
+hot path:
+
+- **Key resolution** rides the shared cached sidecar index
+  (``io/split.py _load_index_cached`` — one parse per (uri, mtime),
+  shared across handles): the key column is kept in record order, and a
+  batch of keys resolves to record positions in ONE vectorized
+  ``searchsorted`` pass. Missing keys are explicit ``None`` results,
+  never an exception and never a wrong record.
+- **Hot blocks come from the caches**: the whole batch's unique blocks
+  go through the two-level ``codec.DecodeContext`` — the in-process L1
+  LRU, then the per-host block-cache daemon (``io/blockcache.py``) in
+  ONE ``get_many`` round trip. A dead or absent daemon degrades to L1
+  silently, exactly like the epoch path.
+- **Residual misses are coalesced parallel ranged reads**: the missing
+  blocks' file spans merge at ``merge_gap`` granularity and ride the
+  splitter's one miss path (``_fetch_blocks``) — the concurrent span
+  fetcher (``io/spanfetch.py``) on remote files with fetch→decode
+  overlap, mmap/pread locally — and every decoded block is published
+  back through the daemon's admission/quota machinery.
+- **Records leave decoded blocks via the frame walk**: per block, one
+  native ``dmlc_walk_record_spans`` call (or one vectorized numpy
+  header pass) turns index slices into payload spans; only the rare
+  multi-part chain (payload containing the aligned magic) is
+  reassembled in Python.
+
+``RecordLookup`` is the library handle; ``LookupServer``/
+``LookupClient`` are the ``tools serve`` daemon mode — a
+length-prefixed-JSON request loop (the framing idiom of
+``blockcache.py``/``dsserve/wire.py``; record payloads follow the JSON
+header as one raw blob, so values never pay base64) with p50/p99
+latency histograms and QPS on the telemetry registry (``io.lookup.*``,
+``/metrics`` via telemetry/export.py) and a ``lookup_wait`` stall stage
+in the flight recorder.
+
+Warming: ``RecordLookup.warm`` prefetches the blocks covering a key set
+(hottest blocks first, optionally capped) and publishes them through
+the block-cache daemon's EXISTING admission control and per-tenant
+quotas — run the serve tier under its own ``DMLC_BLOCK_CACHE_TENANT``
+with a ``DMLC_BLOCK_CACHE_TENANT_MB`` quota and warming can never evict
+an epoch tenant's working set (docs/serving.md).
+
+Lint: L016 confines socket-serving request loops inside
+``dmlc_core_tpu/io/`` to ``blockcache.py`` and this module (and L010's
+socket-import rule exempts both).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import struct
+import threading
+import time as _time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data import native as _native
+from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
+from ..utils.logging import Error, check
+from . import codec as _codec
+from . import recordio as _recordio
+from . import split as _split
+from .blockcache import MAX_FRAME, _recv_all, _recv_frame
+
+__all__ = [
+    "LookupClient",
+    "LookupServer",
+    "RecordLookup",
+]
+
+logger = logging.getLogger("dmlc_core_tpu.io.lookup")
+
+_REG = _default_registry()
+_BATCHES = _REG.counter(
+    "io.lookup.batches", help="batched lookup() calls served"
+)
+_KEYS = _REG.counter("io.lookup.keys", help="keys resolved by lookup()")
+_HITS = _REG.counter(
+    "io.lookup.hits", help="keys that resolved to a record"
+)
+_NEGATIVES = _REG.counter(
+    "io.lookup.negatives", help="keys absent from the index (None results)"
+)
+_BYTES = _REG.counter(
+    "io.lookup.bytes", help="record payload bytes returned by lookup()"
+)
+_BLOCK_HITS = _REG.counter(
+    "io.lookup.block_hits", help="blocks served from the L1/L2 caches"
+)
+_BLOCK_MISSES = _REG.counter(
+    "io.lookup.block_misses", help="blocks fetched+decoded on the miss path"
+)
+_WARMED = _REG.counter(
+    "io.lookup.warm_blocks", help="blocks prefetched by warm()"
+)
+_BATCH_SECONDS = _REG.histogram(
+    "io.lookup.batch_seconds", help="library-level lookup() wall time"
+)
+_REQUEST_SECONDS = _REG.histogram(
+    "io.lookup.request_seconds",
+    help="serve-daemon per-request wall time (p50/p99 on /metrics)",
+)
+_CLIENTS = _REG.gauge(
+    "io.lookup.clients", help="serve-daemon connections currently open"
+)
+
+_MAGIC_MASK = np.uint32((1 << 29) - 1)
+
+
+# -- frame walk: index slices -> payload bytes --------------------------------
+def _extract_payloads(
+    buf: np.ndarray, starts: np.ndarray, sizes: np.ndarray, what: str
+) -> List[bytes]:
+    """Payload bytes of the framed records at ``(starts[i], sizes[i])``
+    slices of ``buf`` (uint8). One native ``dmlc_walk_record_spans``
+    call — or one vectorized numpy header pass — resolves every
+    single-frame record; only multi-part chains fall back to a Python
+    reassembly. A slice that holds no valid record head means the index
+    and the data disagree: checked Error, never a wrong payload."""
+    n = len(starts)
+    if n == 0:
+        return []
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    sizes = np.ascontiguousarray(sizes, dtype=np.int64)
+    check(
+        int(starts.min(initial=0)) >= 0
+        and int((starts + sizes).max(initial=0)) <= len(buf)
+        and bool((sizes >= 8).all()),
+        f"{what}: record slices fall outside the decoded bytes "
+        f"(corrupt index or data)",
+    )
+    res = _native.walk_record_spans(buf, starts, sizes)
+    if res is not None:
+        offs, lens, _nm, nc = res
+        check(
+            nc == 0,
+            f"{what}: {nc} record slices hold no valid record frame "
+            f"(index and data disagree)",
+        )
+    else:
+        # one vectorized pass: gather every record's 8 header bytes,
+        # check magic + cflag, compute payload spans in place
+        hdr = buf[starts[:, None] + np.arange(8)]
+        words = hdr.view("<u4")
+        magic_ok = words[:, 0] == np.uint32(_recordio.KMAGIC)
+        lrec = words[:, 1]
+        cflag = lrec >> np.uint32(29)
+        plen = (lrec & _MAGIC_MASK).astype(np.int64)
+        single = magic_ok & (cflag == 0)
+        fits = (8 + ((plen + 3) & ~np.int64(3))) <= sizes
+        bad = (~magic_ok) | (magic_ok & (cflag > 1)) | (single & ~fits)
+        check(
+            not bool(bad.any()),
+            f"{what}: {int(bad.sum())} record slices hold no valid "
+            f"record frame (index and data disagree)",
+        )
+        offs = np.where(single, starts + 8, np.int64(-2))
+        lens = np.where(single, plen, np.int64(0))
+    out: List[bytes] = []
+    for i in range(n):
+        o = int(offs[i])
+        if o >= 0:
+            out.append(bytes(buf[o : o + int(lens[i])]))
+            continue
+        # multi-part chain (payload contains the aligned magic word):
+        # reassemble through the reference chunk reader — rare by
+        # construction, so per-record Python here costs nothing
+        s = int(starts[i])
+        rec = _recordio.RecordIOChunkReader(
+            memoryview(buf[s : s + int(sizes[i])]), 0, 1
+        ).next_record()
+        check(
+            rec is not None,
+            f"{what}: truncated multi-part record (index and data "
+            f"disagree)",
+        )
+        out.append(bytes(rec))
+    return out
+
+
+class RecordLookup:
+    """Batched multi-key point reads over one indexed ``.rec`` shard
+    (any codec).
+
+    ``lookup(keys) -> [bytes | None, ...]`` — results align with the
+    input keys; a key absent from the index is an explicit ``None``
+    (negative lookup), a corrupt block is a checked Error. Bytes are
+    bit-identical whether a block arrived from the in-process L1, the
+    host daemon, or a fresh fetch+decode — and across codecs, since
+    decoded blocks carry plain v1 frames.
+
+    Thread-safe: one handle serves a multi-threaded daemon (batches
+    serialize on an internal lock — batching, not concurrency, is the
+    throughput lever on this path).
+    """
+
+    def __init__(
+        self,
+        uri: str,
+        index_uri: Optional[str] = None,
+        decode_ctx: Optional[_codec.DecodeContext] = None,
+        merge_gap: int = 65536,
+        filesys=None,
+    ) -> None:
+        self.uri = uri
+        self.index_uri = index_uri or uri + ".idx"
+        self.merge_gap = merge_gap
+        # the splitter IS the substrate: file table, cached index
+        # arrays, cross-process cache identity, span reader/fetcher and
+        # the coalesced block miss path all come from it — lookup adds
+        # key resolution and payload extraction, not a second I/O stack
+        self._sp = _split.IndexedRecordIOSplitter(
+            uri,
+            self.index_uri,
+            0,
+            1,
+            shuffle=False,
+            readahead=False,
+            merge_gap=merge_gap,
+            filesys=filesys,
+            decode_ctx=decode_ctx,
+        )
+        keys = self._sp._index_keys
+        check(
+            keys is not None and len(keys) == len(self._sp._index_offs),
+            f"index file {self.index_uri!r} carries no usable key column",
+        )
+        # sorted-key view for one-searchsorted-per-batch resolution;
+        # computed once per handle (the parsed index itself is shared
+        # through the process-wide LRU)
+        self._key_order = np.argsort(keys, kind="stable")
+        self._keys_sorted = keys[self._key_order]
+        self._lock = threading.Lock()
+        self._codec_memo: Optional[str] = None
+        self.lookups = 0
+        self.keys_resolved = 0
+        self.negatives = 0
+        self.bytes_out = 0
+        self.block_cache_hits = 0
+        self.block_cache_misses = 0
+
+    # -- introspection --------------------------------------------------------
+    @property
+    def n_records(self) -> int:
+        return len(self._keys_sorted)
+
+    @property
+    def compressed(self) -> bool:
+        return bool(self._sp._compressed)
+
+    def describe(self) -> dict:
+        """Index key count + block geometry — what an operator needs to
+        size a serve tier (``tools info <uri>``) without opening the
+        sidecar by hand. Takes the handle lock: the codec probe shares
+        the span reader (and, remotely, its stream cursors) with
+        in-flight lookups."""
+        with self._lock:
+            return self._describe_locked()
+
+    def _describe_locked(self) -> dict:
+        sp = self._sp
+        out = {
+            "records": int(len(sp._index_offs)),
+            "keys": int(len(self._keys_sorted)),
+            "key_dtype": str(self._keys_sorted.dtype),
+            "total_bytes": int(sp.file_offset[-1]),
+            "compressed": bool(sp._compressed),
+        }
+        if sp._compressed:
+            bs = sp._block_sizes
+            out.update(
+                blocks=int(len(bs)),
+                block_bytes={
+                    "min": int(bs.min()),
+                    "mean": int(bs.mean()),
+                    "max": int(bs.max()),
+                },
+                records_per_block=round(len(sp._index_offs) / len(bs), 1),
+                codec=self._codec_name(),
+            )
+        else:
+            out["codec"] = "none"
+        return out
+
+    def _codec_name(self) -> str:
+        """Codec of the first block (28 bytes read: frame + block
+        headers, memoized — one probe per handle) — shards are
+        single-codec by construction of the writer, and 'unknown'
+        degrades instead of failing an info call."""
+        if self._codec_memo is not None:
+            return self._codec_memo
+        self._codec_memo = self._probe_codec()
+        return self._codec_memo
+
+    def _probe_codec(self) -> str:
+        sp = self._sp
+        try:
+            head = bytes(
+                sp._get_span_reader().read(int(sp._block_offs[0]), 28)
+            )
+            magic, lrec = struct.unpack("<II", head[:8])
+            if magic != _recordio.KMAGIC:
+                return "unknown"
+            codec_id = head[8]
+            return _codec.get_codec(int(codec_id)).name
+        except Exception:
+            return "unknown"
+
+    # -- key resolution -------------------------------------------------------
+    @staticmethod
+    def _int_key(k) -> int:
+        """Exact integer coercion: ints (and integer strings, the wire
+        form) pass; a float truncating to a DIFFERENT key would return
+        the wrong record, which this path must never do."""
+        if isinstance(k, bool):  # bool IS int: True would read key 1
+            raise TypeError(f"non-integer key {k!r}")
+        if isinstance(k, (int, np.integer)):
+            return int(k)
+        if isinstance(k, (str, bytes)):
+            return int(k)  # ValueError on '3.7' — no silent truncation
+        raise TypeError(f"non-integer key {k!r}")
+
+    @staticmethod
+    def _str_key(k) -> str:
+        """Exact string coercion: str passes, bytes decode (the sidecar
+        is text, so its keys are utf-8), ints render exactly. Anything
+        else — a float, an arbitrary object — would str() into a key
+        that can never match and masquerade as an honest negative."""
+        if isinstance(k, str):
+            return k
+        if isinstance(k, bytes):
+            return k.decode()
+        if isinstance(k, (int, np.integer)) and not isinstance(k, bool):
+            return str(int(k))
+        raise TypeError(f"non-string key {k!r}")
+
+    def _as_key_array(self, keys: Sequence) -> np.ndarray:
+        if self._keys_sorted.dtype == np.int64:
+            try:
+                return np.asarray(
+                    [self._int_key(k) for k in keys], dtype=np.int64
+                )
+            except (ValueError, TypeError, OverflowError):
+                raise Error(
+                    f"lookup keys must be integers for this index "
+                    f"({self.index_uri!r} has integer keys)"
+                ) from None
+        try:
+            return np.asarray([self._str_key(k) for k in keys])
+        except (TypeError, UnicodeDecodeError):
+            raise Error(
+                f"lookup keys must be strings for this index "
+                f"({self.index_uri!r} has string keys)"
+            ) from None
+
+    def _resolve(self, keys: Sequence) -> Tuple[np.ndarray, np.ndarray]:
+        """(query hit mask, record positions of the hits) — one
+        vectorized searchsorted pass over the sorted key view."""
+        q = self._as_key_array(keys)
+        if len(q) == 0:
+            return np.zeros(0, dtype=bool), np.zeros(0, dtype=np.int64)
+        n = len(self._keys_sorted)
+        pos = np.searchsorted(self._keys_sorted, q)
+        pos_c = np.minimum(pos, max(n - 1, 0))
+        hit = (
+            (pos < n) & (self._keys_sorted[pos_c] == q)
+            if n
+            else np.zeros(len(q), dtype=bool)
+        )
+        recs = self._key_order[pos_c[hit]]
+        return hit, recs.astype(np.int64)
+
+    # -- the batched read -----------------------------------------------------
+    def lookup(self, keys: Sequence) -> List[Optional[bytes]]:
+        """Record payload bytes for every key, ``None`` for keys absent
+        from the index; results align with the input order (duplicate
+        query keys each get the record)."""
+        t0 = _time.perf_counter()
+        with self._lock:
+            out = self._lookup_locked(keys)
+        _BATCH_SECONDS.observe(_time.perf_counter() - t0)
+        return out
+
+    def _lookup_locked(self, keys: Sequence) -> List[Optional[bytes]]:
+        hit, recs = self._resolve(keys)
+        results: List[Optional[bytes]] = [None] * len(hit)
+        n_hit = int(hit.sum())
+        self.lookups += 1
+        self.keys_resolved += len(hit)
+        self.negatives += len(hit) - n_hit
+        _BATCHES.inc()
+        _KEYS.inc(len(hit))
+        _HITS.inc(n_hit)
+        _NEGATIVES.inc(len(hit) - n_hit)
+        if n_hit == 0:
+            return results
+        # duplicates collapse before any I/O planning
+        recs_u, inv = np.unique(recs, return_inverse=True)
+        if self._sp._compressed:
+            payloads_u = self._read_compressed(recs_u)
+        else:
+            payloads_u = self._read_v1(recs_u)
+        nbytes = 0
+        j = 0
+        for i in np.nonzero(hit)[0].tolist():
+            p = payloads_u[int(inv[j])]
+            results[i] = p
+            nbytes += len(p)
+            j += 1
+        self.bytes_out += nbytes
+        _BYTES.inc(nbytes)
+        return results
+
+    def _read_compressed(self, recs: np.ndarray) -> List[bytes]:
+        """Payloads for UNIQUE record positions of a block shard: the
+        batch's unique blocks resolve through the two-level decode
+        context in ONE batched lookup (L1, then one daemon
+        ``get_many`` round trip), misses ride the splitter's coalesced
+        parallel miss path, and each block's records leave via one
+        frame-walk call."""
+        sp = self._sp
+        bids = sp._rec_block[recs]
+        uniq = np.unique(bids)
+        keymap = {int(b): sp._block_key(int(b)) for b in uniq.tolist()}
+        found = sp._decode_ctx.get_blocks(list(keymap.values()))
+        blocks: Dict[int, bytes] = {}
+        missing: List[int] = []
+        for b, k in keymap.items():
+            raw = found.get(k)
+            if raw is None:
+                missing.append(b)
+            else:
+                blocks[b] = raw
+        self.block_cache_hits += len(blocks)
+        self.block_cache_misses += len(missing)
+        sp.decode_cache_hits += len(blocks)
+        sp.decode_cache_misses += len(missing)
+        _BLOCK_HITS.inc(len(blocks))
+        if missing:
+            _BLOCK_MISSES.inc(len(missing))
+            # named span: a cold batch's whole fetch+decode shows as one
+            # region on the timeline, with per-span/per-decode children
+            with _tracing.span(
+                "dmlc:lookup_block_fetch", blocks=len(missing)
+            ):
+                blocks.update(sp._fetch_blocks(sorted(missing)))
+        out: List[bytes] = [b""] * len(recs)
+        order = np.argsort(bids, kind="stable")
+        ob = bids[order]
+        i = 0
+        while i < len(order):
+            b = int(ob[i])
+            j = i
+            while j < len(order) and int(ob[j]) == b:
+                j += 1
+            sel = order[i:j]
+            raw = blocks[b]
+            buf = np.frombuffer(raw, dtype=np.uint8)
+            starts = sp._rec_inoff[recs[sel]]
+            nxt = sp._rec_next[recs[sel]]
+            ends = np.where(nxt >= 0, nxt, len(raw))
+            payloads = _extract_payloads(
+                buf, starts, ends - starts, f"lookup {self.uri!r}"
+            )
+            for k, p in zip(sel.tolist(), payloads):
+                out[int(k)] = p
+            i = j
+        return out
+
+    def _read_v1(self, recs: np.ndarray) -> List[bytes]:
+        """Payloads for UNIQUE record positions of an uncompressed
+        shard: the records' framed byte ranges coalesce into spans at
+        ``merge_gap`` granularity and read through the splitter's span
+        machinery (zero-copy mmap locally, parallel ranged reads via
+        the span fetcher on remote files), then one frame-walk pass
+        slices payloads out of the span buffer."""
+        sp = self._sp
+        offs = sp._index_offs[recs]
+        sizes = sp._index_sizes[recs]
+        order, s_starts, s_ends = _split._plan_span_bounds(
+            offs, sizes, self.merge_gap
+        )
+        span_begin = offs[order][s_starts]
+        run_end = np.maximum.accumulate(offs[order] + sizes[order])
+        span_len = run_end[s_ends - 1] - span_begin
+        buf = sp._read_spans(span_begin, span_len)
+        span_of = np.repeat(np.arange(len(s_starts)), s_ends - s_starts)
+        base = np.concatenate(([0], np.cumsum(span_len)[:-1]))
+        rel = offs[order] - span_begin[span_of] + base[span_of]
+        sorted_payloads = _extract_payloads(
+            np.ascontiguousarray(buf),
+            rel,
+            sizes[order],
+            f"lookup {self.uri!r}",
+        )
+        out: List[bytes] = [b""] * len(recs)
+        for j, k in enumerate(order.tolist()):
+            out[int(k)] = sorted_payloads[j]
+        return out
+
+    # -- warming --------------------------------------------------------------
+    def warm(
+        self,
+        keys: Optional[Sequence] = None,
+        max_blocks: Optional[int] = None,
+    ) -> int:
+        """Prefetch the decoded blocks covering ``keys`` (``None`` = the
+        whole shard), hottest blocks — the ones covering the most
+        requested keys — first, optionally capped at ``max_blocks``.
+        Fetched blocks publish through the block-cache daemon's EXISTING
+        admission control and per-tenant quota machinery (a quota'd
+        serve tenant can never evict an epoch tenant's working set —
+        docs/serving.md). Returns the number of blocks actually
+        fetched+decoded (already-cached blocks cost nothing).
+        Uncompressed shards have no decoded-block tier: no-op."""
+        if not self._sp._compressed:
+            return 0
+        with self._lock:
+            sp = self._sp
+            if keys is None:
+                bids = sp._rec_block
+            else:
+                _hit, recs = self._resolve(keys)
+                if len(recs) == 0:
+                    return 0
+                bids = sp._rec_block[recs]
+            uniq, counts = np.unique(bids, return_counts=True)
+            hot = uniq[np.argsort(-counts, kind="stable")]
+            if max_blocks is not None:
+                hot = hot[: max(int(max_blocks), 0)]
+            keymap = {int(b): sp._block_key(int(b)) for b in hot.tolist()}
+            found = sp._decode_ctx.get_blocks(list(keymap.values()))
+            missing = sorted(
+                b for b, k in keymap.items() if k not in found
+            )
+            if missing:
+                with _tracing.span(
+                    "dmlc:lookup_warm", blocks=len(missing)
+                ):
+                    sp._fetch_blocks(missing)
+                _WARMED.inc(len(missing))
+            return len(missing)
+
+    def io_stats(self) -> Dict[str, object]:
+        base = self._sp.io_stats()
+        base.update(
+            lookups=self.lookups,
+            keys_resolved=self.keys_resolved,
+            negatives=self.negatives,
+            lookup_bytes=self.bytes_out,
+            block_cache_hits=self.block_cache_hits,
+            block_cache_misses=self.block_cache_misses,
+        )
+        return base
+
+    def close(self) -> None:
+        self._sp.close()
+
+
+# -- wire framing (blockcache idiom + a raw payload blob) ---------------------
+def _send_frame(sock: socket.socket, obj: dict, payload: bytes = b"") -> None:
+    data = json.dumps(obj, separators=(",", ":")).encode()
+    # reject at the SENDER: the receiver drops an oversized frame's
+    # connection, and the failure would masquerade as a dead daemon
+    # (the collective.py oversized-payload lesson). Record payloads are
+    # not capped — only the JSON header is a control frame.
+    if len(data) > MAX_FRAME:
+        raise Error(
+            f"lookup control frame of {len(data)} bytes exceeds the "
+            f"{MAX_FRAME}-byte cap — split the key batch"
+        )
+    sock.sendall(struct.pack("<I", len(data)) + data + payload)
+# frame RECEIVE hygiene (length cap, close semantics) is shared with
+# blockcache._recv_frame — one implementation per the L016 rationale;
+# only the send side differs here (the appended raw payload blob)
+
+
+class LookupServer:
+    """The ``tools serve`` daemon: batched point lookups over one
+    indexed shard on a TCP request loop.
+
+    Protocol (one request frame in, one response frame out, per the
+    blockcache framing idiom): 4-byte LE length + compact JSON. A
+    ``lookup`` response's JSON header carries ``sizes`` (-1 = negative
+    lookup) and the record payloads follow the header as ONE raw blob
+    in key order — values never pay base64 or JSON escaping. Ops:
+    ``lookup`` (keys), ``warm`` (keys/max_blocks), ``stats``, ``ping``.
+
+    Telemetry: every request ticks ``io.lookup.requests{op=...}`` and
+    observes ``io.lookup.request_seconds`` (the p50/p99 the acceptance
+    bench pins); ``metrics_port`` serves the process registry on
+    ``/metrics`` (telemetry/export.py).
+    """
+
+    def __init__(
+        self,
+        handle: RecordLookup,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_port: int = 0,
+    ) -> None:
+        self.handle = handle
+        self.host = host
+        self._sock = socket.create_server((host, port), backlog=64)
+        self.port = self._sock.getsockname()[1]
+        self._closed = threading.Event()
+        self._conns: set = set()
+        self._lock = threading.Lock()
+        self._t0 = _time.perf_counter()
+        self.requests = 0
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="lookup-accept"
+        )
+        self._accept_thread.start()
+        self._metrics_server = None
+        if metrics_port:
+            from ..telemetry.export import serve_metrics_http
+
+            self._metrics_server = serve_metrics_http(
+                metrics_port, registry=_REG, json_provider=self.stats,
+                name="lookup-metrics-http",
+            )
+        logger.info(
+            "lookup daemon serving %s:%d over %s",
+            host, self.port, handle.uri,
+        )
+
+    def serve_forever(self) -> None:
+        """Block until ``close()`` (foreground CLI mode)."""
+        self._closed.wait()
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+        with self._lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        if self._metrics_server is not None:
+            try:
+                self._metrics_server.shutdown()
+                self._metrics_server.server_close()
+            except Exception:
+                pass
+
+    def _accept_loop(self) -> None:
+        # a timed accept keeps close() prompt: closing a listening
+        # socket from another thread does not reliably unblock a
+        # blocked accept(), so the loop polls the closed flag instead
+        # (the dsserve server idiom)
+        self._sock.settimeout(0.25)
+        while not self._closed.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # closed
+            conn.settimeout(None)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="lookup-conn",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        with self._lock:
+            self._conns.add(conn)
+        _CLIENTS.inc(1)
+        try:
+            while True:
+                try:
+                    req = _recv_frame(conn)
+                except (ConnectionError, OSError, ValueError):
+                    return
+                t0 = _time.perf_counter()
+                op = str(req.get("op"))
+                try:
+                    resp, payload = self._handle(op, req)
+                except Error as e:  # checked: answer, keep the conn
+                    resp, payload = {"ok": False, "error": str(e)}, b""
+                except Exception as e:  # one bad request, not the daemon
+                    logger.exception("lookup request failed")
+                    resp, payload = {"ok": False, "error": repr(e)}, b""
+                self.requests += 1
+                # clamp the label to the known vocabulary — a hostile
+                # op string must not mint unbounded metric series any
+                # more than unbounded span names
+                _REG.counter(
+                    "io.lookup.requests",
+                    labels={"op": op if op in self._OPS else "unknown"},
+                ).inc()
+                try:
+                    _send_frame(conn, resp, payload)
+                except Error as e:
+                    # the RESPONSE header outgrew the frame cap (a huge
+                    # sizes array): answer with a compact refusal so the
+                    # client sees a checked error, not a dead daemon
+                    try:
+                        _send_frame(conn, {"ok": False, "error": str(e)})
+                    except (Error, OSError):
+                        return
+                except OSError:
+                    return
+                _REQUEST_SECONDS.observe(_time.perf_counter() - t0)
+        finally:
+            with self._lock:
+                self._conns.discard(conn)
+            _CLIENTS.inc(-1)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    #: known ops — also the trace-span vocabulary (a hostile op string
+    #: must not mint unbounded span names on the ring)
+    _OPS = frozenset({"ping", "lookup", "warm", "stats"})
+
+    def _handle(self, op: str, req: dict) -> Tuple[dict, bytes]:
+        span = f"dmlc:lookup_{op if op in self._OPS else 'unknown'}"
+        with _tracing.span(span):
+            if op == "ping":
+                return {"ok": True, "pid": os.getpid()}, b""
+            if op == "lookup":
+                keys = req.get("keys", [])
+                # a scalar here is a client serialization bug: a JSON
+                # string would iterate char-by-char into VALID keys and
+                # answer wrong records with ok:true
+                check(
+                    isinstance(keys, (list, tuple)),
+                    f"lookup keys must be a JSON array, got "
+                    f"{type(keys).__name__}",
+                )
+                vals = self.handle.lookup(keys)
+                sizes = [
+                    -1 if v is None else len(v) for v in vals
+                ]
+                payload = b"".join(v for v in vals if v is not None)
+                return {"ok": True, "sizes": sizes}, payload
+            if op == "warm":
+                keys = req.get("keys")
+                check(
+                    keys is None or isinstance(keys, (list, tuple)),
+                    f"warm keys must be a JSON array, got "
+                    f"{type(keys).__name__}",
+                )
+                n = self.handle.warm(keys, req.get("max_blocks"))
+                return {"ok": True, "warmed_blocks": n}, b""
+            if op == "stats":
+                return {"ok": True, "stats": self.stats()}, b""
+            return {"ok": False, "error": f"unknown op {op!r}"}, b""
+
+    def stats(self) -> dict:
+        """Request counts/QPS/uptime are per-server; p50/p99 come from
+        the PROCESS-global ``io.lookup.request_seconds`` histogram (the
+        repo-wide registry convention) — a process hosting several
+        servers reads blended percentiles."""
+        h = self.handle
+        uptime = _time.perf_counter() - self._t0
+        hist = _REG.snapshot().get("histograms", {}).get(
+            "io.lookup.request_seconds", {}
+        )
+        return {
+            "pid": os.getpid(),
+            "host": self.host,
+            "port": self.port,
+            "uri": h.uri,
+            "uptime_secs": round(uptime, 3),
+            "requests": self.requests,
+            "qps": round(self.requests / max(uptime, 1e-9), 2),
+            "p50_ms": round(hist.get("p50", 0.0) * 1e3, 3),
+            "p99_ms": round(hist.get("p99", 0.0) * 1e3, 3),
+            "lookups": h.lookups,
+            "keys_resolved": h.keys_resolved,
+            "negatives": h.negatives,
+            "bytes": h.bytes_out,
+            "block_cache_hits": h.block_cache_hits,
+            "block_cache_misses": h.block_cache_misses,
+            "shard": h.describe(),
+        }
+
+
+def _wire_keys(keys: Sequence) -> list:
+    """JSON-able key list with the handle's coercion strictness: ints
+    and strings pass, bytes decode (the sidecar is text); anything else
+    would str() into a never-matching key and fake a negative."""
+    out = []
+    for k in keys:
+        if isinstance(k, (int, np.integer)):
+            out.append(int(k))
+        elif isinstance(k, str):
+            out.append(k)
+        elif isinstance(k, bytes):
+            try:
+                out.append(k.decode())
+            except UnicodeDecodeError:
+                raise Error(f"undecodable bytes lookup key {k!r}") from None
+        else:
+            raise Error(
+                f"lookup keys must be ints or strings, got {k!r}"
+            )
+    return out
+
+
+class LookupClient:
+    """One connection to a ``LookupServer``; the RTT wait is a
+    ``lookup_wait`` stall stage on the flight recorder (a slow serve
+    tier shows up in the stall report by name, docs/observability.md).
+    Thread-safe behind a lock (one in-flight request per connection)."""
+
+    def __init__(
+        self, host: str, port: int, timeout: float = 30.0
+    ) -> None:
+        self.host = host
+        self.port = int(port)
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+
+    def _connect_locked(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection(
+                (self.host, self.port), timeout=self._timeout
+            )
+            self._sock = s
+        return self._sock
+
+    def _request(
+        self, obj: dict, want_payload: bool = False
+    ) -> Tuple[dict, bytes]:
+        with self._lock:
+            sock = self._connect_locked()
+            try:
+                _send_frame(sock, obj)
+                with _tracing.span("dmlc:lookup_wait"):
+                    resp = _recv_frame(sock)
+                    payload = b""
+                    if want_payload and resp.get("ok"):
+                        total = sum(
+                            s for s in resp.get("sizes", ()) if s > 0
+                        )
+                        if total:
+                            payload = _recv_all(sock, total)
+            except (OSError, ConnectionError, ValueError) as e:
+                self._close_locked()
+                raise Error(
+                    f"lookup daemon {self.host}:{self.port} "
+                    f"unreachable: {e}"
+                ) from e
+        if not resp.get("ok"):
+            raise Error(
+                f"lookup daemon {self.host}:{self.port} refused "
+                f"{obj.get('op')!r}: {resp.get('error')}"
+            )
+        return resp, payload
+
+    def lookup(self, keys: Sequence) -> List[Optional[bytes]]:
+        keys = _wire_keys(keys)
+        resp, payload = self._request(
+            {"op": "lookup", "keys": keys}, want_payload=True
+        )
+        sizes = resp.get("sizes", [])
+        check(
+            len(sizes) == len(keys),
+            "lookup daemon answered the wrong key count",
+        )
+        out: List[Optional[bytes]] = []
+        at = 0
+        for s in sizes:
+            if s < 0:
+                out.append(None)
+            else:
+                out.append(payload[at : at + s])
+                at += s
+        check(
+            at == len(payload),
+            "lookup daemon payload length disagrees with its sizes",
+        )
+        return out
+
+    def warm(
+        self,
+        keys: Optional[Sequence] = None,
+        max_blocks: Optional[int] = None,
+    ) -> int:
+        req: dict = {"op": "warm", "max_blocks": max_blocks}
+        if keys is not None:
+            req["keys"] = _wire_keys(keys)
+        resp, _ = self._request(req)
+        return int(resp.get("warmed_blocks", 0))
+
+    def stats(self) -> dict:
+        resp, _ = self._request({"op": "stats"})
+        return resp["stats"]
+
+    def ping(self) -> bool:
+        try:
+            self._request({"op": "ping"})
+            return True
+        except Error:
+            return False
+
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._close_locked()

@@ -81,6 +81,10 @@ _REG = _default_registry()
 _SPANS = _REG.counter("io.split.spans", help="positioned reads issued")
 _SEEKS = _REG.counter("io.split.seeks", help="stream seek() calls")
 _BYTES_READ = _REG.counter("io.split.bytes_read", help="bytes read by splits")
+_INDEX_EVICTIONS = _REG.counter(
+    "io.split.index_cache_evictions",
+    help="parsed sidecar indexes evicted from the bytes-bounded LRU",
+)
 _RECORDS = _REG.counter("io.split.records", help="records emitted by splits")
 _GATHER_BATCHES = _REG.counter(
     "io.split.gather_batches",
@@ -714,7 +718,34 @@ def _load_index_cached(stat_key) -> Dict[str, np.ndarray]:
             while _INDEX_CACHE_BYTES > budget and len(_INDEX_CACHE) > 1:
                 _k, old = _INDEX_CACHE.popitem(last=False)
                 _INDEX_CACHE_BYTES -= sum(v.nbytes for v in old.values())
+                # a many-corpus serve daemon cycling indexes shows up
+                # here, not as silent RSS growth (docs/observability.md)
+                _INDEX_EVICTIONS.inc()
     return data
+
+
+def _parse_index_keys(kvals: List[str], index_uri: str) -> np.ndarray:
+    """The sidecar's key column as a numpy array (int64 when every key
+    parses as an integer — the writer's default ordinals and the common
+    user-key shape — else the raw strings), REJECTING duplicates with a
+    checked Error: the epoch paths never read keys, but the point-read
+    path (io/lookup.py) resolves by them, and a duplicated key silently
+    serving whichever record sorts last is a wrong-answer hazard, not a
+    formatting nit."""
+    try:
+        keys = np.asarray(kvals, dtype=np.int64)
+    except (ValueError, OverflowError):
+        keys = np.asarray(kvals)
+    ks = np.sort(keys)
+    dup = np.nonzero(ks[1:] == ks[:-1])[0]
+    if dup.size:
+        raise Error(
+            f"index file {index_uri!r}: duplicate key {ks[int(dup[0])]!r} "
+            f"({dup.size + 1 if dup.size == 1 else 'several'} keys repeat) "
+            f"— a point lookup would silently return an arbitrary one of "
+            f"the records sharing it"
+        )
+    return keys
 
 
 def _parse_index_text(
@@ -723,26 +754,38 @@ def _parse_index_text(
     """Vectorized index parse → read-only numpy arrays. v1 sidecar
     (``key offset``): {'offs', 'sizes'}; compressed-block sidecar
     (``key block:inoff``, docs/recordio.md): the record→block geometry.
-    One C-speed str→int64 conversion instead of a 2-per-record Python
-    loop — the index parse sits on every indexed construction's
-    critical path (it gated the shuffled-epoch rebuild)."""
-    vals = text.split()[1::2]
+    Both carry ``keys`` — the key column in the SAME record order as the
+    offset arrays, so the point-read path (io/lookup.py) resolves
+    key→position without a second parse. One C-speed str→int64
+    conversion instead of a 2-per-record Python loop — the index parse
+    sits on every indexed construction's critical path (it gated the
+    shuffled-epoch rebuild)."""
+    toks = text.split()
+    vals = toks[1::2]
     if not vals:
         raise Error(f"empty index file {index_uri!r}")
+    check(
+        len(toks) % 2 == 0,
+        f"index file {index_uri!r}: odd token count (truncated or "
+        f"malformed key/offset pairs)",
+    )
+    keys = _parse_index_keys(toks[0::2], index_uri)
     mixed = Error(
         f"index file {index_uri!r} mixes v1 and compressed-block offsets"
     )
     if ":" in vals[0]:
-        out = _parse_compressed_index(vals, total, index_uri, mixed)
+        out = _parse_compressed_index(vals, keys, total, index_uri, mixed)
     else:
         try:
-            offs = np.sort(np.asarray(vals, dtype=np.int64))
+            raw = np.asarray(vals, dtype=np.int64)
         except ValueError:
             raise mixed from None
+        order = np.argsort(raw, kind="stable")
+        offs = raw[order]
         sizes = np.concatenate(
             (np.diff(offs), [total - int(offs[-1])])
         ).astype(np.int64)
-        out = {"offs": offs, "sizes": sizes}
+        out = {"offs": offs, "sizes": sizes, "keys": keys[order]}
     for v in out.values():
         v.setflags(write=False)  # cached arrays are shared across splits
     return out
@@ -752,7 +795,8 @@ _COMPRESSED_INDEX_RE = re.compile(r"\d+:\d+(?: \d+:\d+)*")
 
 
 def _parse_compressed_index(
-    vals: List[str], total: int, index_uri: str, mixed: Error
+    vals: List[str], keys: np.ndarray, total: int, index_uri: str,
+    mixed: Error,
 ) -> Dict[str, np.ndarray]:
     """Compressed sidecar: ``key  <block>:<in>`` per record — the block
     frame's file offset and the record's frame start inside the DECODED
@@ -802,6 +846,7 @@ def _parse_compressed_index(
         "rec_next": nxt,
         "block_offs": boffs,
         "block_sizes": block_sizes,
+        "keys": keys[order],
     }
 
 
@@ -1083,6 +1128,9 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         # shared across sub-shard splitters via _load_index_cached)
         self._index_offs = np.empty(0, dtype=np.int64)
         self._index_sizes = np.empty(0, dtype=np.int64)
+        # the sidecar's key column, record order (None until the index
+        # loads) — the point-read path (io/lookup.py) resolves by it
+        self._index_keys: Optional[np.ndarray] = None
         # compressed-block geometry (set by _read_index_file when the
         # sidecar carries block:in-offset pairs — docs/recordio.md)
         self._compressed = False
@@ -1114,6 +1162,7 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             else _load_index_uri(self._index_uri, total)
         )
         self._index_loaded = True
+        self._index_keys = data.get("keys")
         if "offs" in data:
             self._index_offs = data["offs"]
             self._index_sizes = data["sizes"]

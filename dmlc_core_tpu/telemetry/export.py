@@ -18,7 +18,7 @@ from typing import Any, Callable, Dict, Optional, TextIO, Union
 
 from .registry import MetricRegistry, default_registry, render_key, split_key
 
-__all__ = ["Reporter", "to_json", "to_prometheus"]
+__all__ = ["Reporter", "serve_metrics_http", "to_json", "to_prometheus"]
 
 logger = logging.getLogger("dmlc_core_tpu.telemetry")
 
@@ -136,6 +136,62 @@ def to_prometheus(
             f"{_series(name + '_count', labels)} {_fmt(hist['count'])}"
         )
     return "\n".join(lines) + "\n"
+
+
+def serve_metrics_http(
+    port: int,
+    registry: Optional[MetricRegistry] = None,
+    json_provider: Optional[Callable[[], Dict[str, Any]]] = None,
+    name: str = "metrics-http",
+):
+    """Loopback ``/metrics`` server over a process registry — the
+    single-process exporter every foreground daemon (the block-cache
+    daemon, the point-read serve daemon) rides instead of hand-rolling
+    its own handler. Serves Prometheus text on ``/metrics`` and, when
+    ``json_provider`` is given, its dict as JSON on ``/metrics.json``,
+    ``/json`` and ``/stats``. Render failures answer 500 per request,
+    never kill the server thread. Returns the started
+    ``ThreadingHTTPServer`` (caller shuts it down)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    reg = registry or default_registry()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:  # noqa: N802 (http.server contract)
+            path = self.path.split("?", 1)[0]
+            try:
+                if path == "/metrics":
+                    body = to_prometheus(reg).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif json_provider is not None and path in (
+                    "/metrics.json", "/json", "/stats"
+                ):
+                    body = json.dumps(json_provider()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+            except Exception:
+                logger.exception("metrics render failed")
+                self.send_response(500)
+                self.end_headers()
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt: str, *args) -> None:
+            logger.debug("metrics http: " + fmt, *args)
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    server.daemon_threads = True
+    threading.Thread(
+        target=server.serve_forever, daemon=True, name=name
+    ).start()
+    return server
 
 
 class Reporter:

@@ -604,6 +604,10 @@ _WAIT_STAGES = frozenset(
                               # preprocessing tier: network-bound or
                               # under-provisioned dsserve workers
                               # (dmlc_core_tpu/dsserve/client.py)
+        "lookup_wait",        # point-read client blocked on the serve
+                              # daemon's answer: a cold cache, an
+                              # overloaded tier, or network latency
+                              # (io/lookup.py LookupClient)
         "slot_wait",
     }
 )

@@ -293,12 +293,76 @@ def _cmd_dump(args) -> int:
 
 def _cmd_info(args) -> int:
     """Runtime feature report (build_info): native kernels, env flags,
-    accelerator runtime — the base.h feature macros as runtime facts."""
+    accelerator runtime — the base.h feature macros as runtime facts.
+    With a shard URI, also the indexed shard's key count and block
+    geometry (io/lookup.py ``describe``) — what an operator needs to
+    size a serve tier without opening the sidecar by hand."""
     import json
 
     from .. import build_info
 
-    print(json.dumps(build_info(), indent=2))
+    report = build_info()
+    if getattr(args, "uri", None):
+        from ..io.lookup import RecordLookup
+
+        handle = RecordLookup(args.uri, args.index or None)
+        try:
+            report["shard"] = handle.describe()
+        finally:
+            handle.close()
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    """The point-read serve daemon (io/lookup.py, docs/serving.md):
+    batched ``lookup(keys)`` over one indexed shard on a TCP request
+    loop, with p50/p99 latency histograms and QPS on ``/metrics``.
+    ``--warm N`` prefetches the shard's N hottest blocks through the
+    block-cache daemon's admission/quota machinery before serving;
+    ``--port-file`` writes a JSON readiness signal for launchers."""
+    import json
+    import signal
+
+    from ..io.lookup import LookupServer, RecordLookup
+    from ..telemetry import tracing
+
+    tracing.set_process_label("lookup-daemon")
+    handle = RecordLookup(args.uri, args.index or None)
+    if args.warm:
+        n = handle.warm(max_blocks=args.warm)
+        print(f"warmed {n} blocks", file=sys.stderr)
+    server = LookupServer(
+        handle, host=args.host, port=args.port,
+        metrics_port=args.metrics_port,
+    )
+    if args.port_file:
+        from ..dsserve.server import write_port_file
+
+        write_port_file(args.port_file, args.host, server.port)
+    signal.signal(signal.SIGTERM, lambda *_a: server.close())
+    print(
+        f"lookup daemon pid {os.getpid()} serving "
+        f"{args.host}:{server.port} over {args.uri}"
+        + (
+            f" (/metrics on 127.0.0.1:{args.metrics_port})"
+            if args.metrics_port
+            else ""
+        ),
+        file=sys.stderr,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+        # stats before handle.close(): the shard-geometry section probes
+        # through the handle's span reader, which a closed handle would
+        # lazily (and wrongly) reconstruct
+        stats = server.stats()
+        handle.close()
+        print(json.dumps(stats), file=sys.stderr)
     return 0
 
 
@@ -666,8 +730,45 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="stop after N rows (0 = all)")
     dp.set_defaults(fn=_cmd_dump)
 
-    info = sub.add_parser("info", help="runtime feature report (JSON)")
+    info = sub.add_parser(
+        "info",
+        help="runtime feature report (JSON); with a shard URI, also "
+             "its index key count + block geometry",
+    )
+    info.add_argument(
+        "uri", nargs="?", default="",
+        help="optional indexed .rec URI to describe",
+    )
+    info.add_argument(
+        "--index", default="",
+        help="index sidecar URI (default <uri>.idx)",
+    )
     info.set_defaults(fn=_cmd_info)
+
+    sv = sub.add_parser(
+        "serve", help="low-latency point-read daemon over an indexed shard"
+    )
+    sv.add_argument("uri", help="indexed .rec URI to serve")
+    sv.add_argument(
+        "--index", default="", help="index sidecar URI (default <uri>.idx)"
+    )
+    sv.add_argument("--host", default="127.0.0.1")
+    sv.add_argument(
+        "--port", default=0, type=int, help="TCP port (0 = any free)"
+    )
+    sv.add_argument(
+        "--port-file", default="",
+        help="write a JSON readiness file naming the bound endpoint",
+    )
+    sv.add_argument(
+        "--metrics-port", default=0, type=int,
+        help="loopback /metrics port (0 = off)",
+    )
+    sv.add_argument(
+        "--warm", default=0, type=int,
+        help="prefetch the N hottest blocks before serving (0 = off)",
+    )
+    sv.set_defaults(fn=_cmd_serve)
 
     cd = sub.add_parser(
         "cached", help="host-level shared decoded-block cache daemon"

@@ -1261,6 +1261,54 @@ DMLC_API void dmlc_parse_rowrec_gather_ell(
   out->corrupt = corrupt ? 1 : 0;
 }
 
+// -- batched point-read frame walk: payload spans -----------------------------
+//
+// The lookup hot path (io/lookup.py): given per-record byte slices of a
+// decoded block (or a v1 span buffer) — each (starts[i], sizes[i]) must
+// begin at a frame head — emit the PAYLOAD span of every single-frame
+// record in one native call, no per-record Python. Multi-part chains
+// (payloads containing the aligned magic word — rare by construction)
+// cannot be expressed as a slice of the input buffer, so they are
+// marked out_off = -2 and the caller reassembles those few in Python;
+// a slice that does not start at a valid head (index/data mismatch) is
+// marked out_off = -1 and counted corrupt — callers fail fast.
+DMLC_API void dmlc_walk_record_spans(
+    const char* buf, const int64_t* starts, const int64_t* sizes,
+    int64_t n, int64_t* out_off, int64_t* out_len,
+    int64_t* n_multipart, int64_t* n_corrupt) {
+  int64_t nm = 0, nc = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const char* p = buf + starts[i];
+    const int64_t avail = sizes[i];
+    out_len[i] = 0;
+    if (avail < 8 || load_u32(p) != kRecMagic) {
+      out_off[i] = -1;
+      ++nc;
+      continue;
+    }
+    const uint32_t lrec = load_u32(p + 4);
+    const uint32_t cflag = (lrec >> 29) & 7u;
+    const int64_t pl = static_cast<int64_t>(lrec & ((1u << 29) - 1u));
+    if (cflag == 0) {  // complete single-frame record: payload in place
+      if (avail < 8 + ((pl + 3) & ~int64_t{3})) {
+        out_off[i] = -1;  // frame runs past the slice: index mismatch
+        ++nc;
+        continue;
+      }
+      out_off[i] = starts[i] + 8;
+      out_len[i] = pl;
+    } else if (cflag == 1) {  // chain start: Python reassembles
+      out_off[i] = -2;
+      ++nm;
+    } else {  // mid-chain / compressed head at a record start: corrupt
+      out_off[i] = -1;
+      ++nc;
+    }
+  }
+  *n_multipart = nm;
+  *n_corrupt = nc;
+}
+
 // -- fused libfm -> fixed-shape ELL batch -------------------------------------
 //
 // Same resumable text-chunk contract as dmlc_parse_libsvm_dense (line walk,

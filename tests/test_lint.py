@@ -536,6 +536,54 @@ def test_struct_framing_quiet_outside_scope_and_in_owners(tmp_path):
         "import struct\nn = struct.calcsize('<I')\n", tmp_path) == []
 
 
+def test_socket_serving_loop_flagged_in_io(tmp_path):
+    """L016: socket-serving request loops inside dmlc_core_tpu/io/ are
+    confined to blockcache.py (shared-cache control plane) and
+    lookup.py (point-read serve daemon) — a third loop forks connection
+    lifecycle and frame hygiene per site."""
+    # accept/listen on any object are the loop markers (no socket
+    # import needed, so L010 stays out of the assertion)
+    assert [c for c, _ in _lib_findings(
+        "conn, addr = srv.accept()\n", tmp_path)] == ["L016"]
+    assert [c for c, _ in _lib_findings(
+        "srv.listen(64)\n", tmp_path)] == ["L016"]
+    # socket.create_server under an import trips BOTH the import rule
+    # (L010) and the serving rule
+    assert sorted(c for c, _ in _lib_findings(
+        "import socket\nsrv = socket.create_server(('', 0))\n", tmp_path
+    )) == ["L010", "L016"]
+    assert sorted(c for c, _ in _lib_findings(
+        "from socket import create_server as cs\nsrv = cs(('', 0))\n",
+        tmp_path,
+    )) == ["L010", "L016"]
+    # per-line opt-out works like every other rule
+    assert _lib_findings(
+        "srv.listen(4)  # noqa: L016 (fixture)\n", tmp_path) == []
+
+
+def test_socket_serving_loop_quiet_outside_io_and_in_owners(tmp_path):
+    # scoped to dmlc_core_tpu/io/ — the tracker and dsserve servers are
+    # their own sanctioned wire layers, scripts do as they like
+    assert codes("conn = srv.accept()\n", tmp_path) == []
+    d = tmp_path / "dmlc_core_tpu" / "dsserve"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "server.py"
+    f.write_text("conn, a = srv.accept()\nsrv.listen(8)\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # the two sanctioned io/ servers are exempt
+    dio = tmp_path / "dmlc_core_tpu" / "io"
+    dio.mkdir(parents=True, exist_ok=True)
+    for owner in ("blockcache.py", "lookup.py"):
+        f = dio / owner
+        f.write_text(
+            "import socket\nsrv = socket.create_server(('', 0))\n"
+            "srv.listen(8)\nconn, a = srv.accept()\n"
+        )
+        assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # dialing out is not serving (that is L010's business when imported)
+    assert _lib_findings("s = cs.connect(('h', 1))\n", tmp_path) == []
+
+
 def test_syntax_error_reported_not_raised(tmp_path):
     assert codes("def f(:\n", tmp_path) == ["L000"]
 

@@ -1,0 +1,463 @@
+"""The batched point-read path (io/lookup.py, docs/serving.md):
+key resolution over the cached sidecar index, block reads through the
+two-level decode context, frame-walk payload extraction, the serve
+daemon, and the degradation matrix — results must be bit-identical
+across {daemon on, daemon dead, L1-only} × {v1, zlib}.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dmlc_core_tpu.io import codec as io_codec
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.io.blockcache import BlockCacheClient, BlockCacheDaemon
+from dmlc_core_tpu.io.lookup import (
+    LookupClient,
+    LookupServer,
+    RecordLookup,
+    _extract_payloads,
+)
+from dmlc_core_tpu.io.recordio import KMAGIC, IndexedRecordIOWriter
+from dmlc_core_tpu.io.stream import FileStream
+from dmlc_core_tpu.tools import main as tools_main
+from dmlc_core_tpu.utils.logging import Error
+
+N_RECORDS = 400
+
+
+def _payload(i: int) -> bytes:
+    if i == 77:
+        # an aligned magic word inside the payload forces the writer's
+        # multi-part escape — the frame-walk's Python reassembly path
+        return struct.pack("<I", KMAGIC) + b"chain" + struct.pack("<I", KMAGIC)
+    return (b"%06d:" % i) + bytes([i % 251]) * (i % 53)
+
+
+def _write_corpus(path, codec=None, n=N_RECORDS, key_fn=None, block_bytes=1024):
+    with FileStream(path, "w") as f, FileStream(path + ".idx", "w") as fi:
+        w = IndexedRecordIOWriter(f, fi, codec=codec, block_bytes=block_bytes)
+        for i in range(n):
+            key = i * 3 if key_fn is None else key_fn(i)
+            w.write_record(_payload(i), key=key)
+        w.flush()
+    return path
+
+
+def _l1_ctx():
+    """A private L1-only decode context: no process-global cache, no
+    daemon — every test measures its own reads."""
+    return io_codec.DecodeContext(
+        cache=io_codec.DecodedBlockCache(64 << 20), shared=None
+    )
+
+
+@pytest.fixture(params=["none", "zlib"])
+def corpus(request, tmp_path):
+    codec = None if request.param == "none" else request.param
+    return _write_corpus(str(tmp_path / f"c_{request.param}.rec"), codec)
+
+
+# -- core semantics -----------------------------------------------------------
+def test_lookup_roundtrip_negatives_and_duplicates(corpus):
+    h = RecordLookup(corpus, decode_ctx=_l1_ctx())
+    try:
+        keys = [0, 3, 231, 10**9, 231, -5, 3 * (N_RECORDS - 1)]
+        vals = h.lookup(keys)
+        assert vals[0] == _payload(0)
+        assert vals[1] == _payload(1)
+        assert vals[2] == _payload(77)  # the multi-part record
+        assert vals[3] is None and vals[5] is None  # explicit negatives
+        assert vals[4] == vals[2]  # duplicate query keys both answered
+        assert vals[6] == _payload(N_RECORDS - 1)
+        assert h.lookup([]) == []
+        stats = h.io_stats()
+        assert stats["negatives"] == 2
+        assert stats["keys_resolved"] == 7
+    finally:
+        h.close()
+
+
+def test_cross_codec_parity(tmp_path):
+    """v1 and zlib shards answer identical bytes for identical keys —
+    decoded blocks carry plain v1 frames, so the codec can never leak
+    into lookup results."""
+    v1 = _write_corpus(str(tmp_path / "v1.rec"), None)
+    zl = _write_corpus(str(tmp_path / "zl.rec"), "zlib")
+    raw = _write_corpus(str(tmp_path / "raw.rec"), "raw")
+    keys = [0, 3, 231, 999, 3 * (N_RECORDS - 1), 42 * 3]
+    answers = []
+    for path in (v1, zl, raw):
+        h = RecordLookup(path, decode_ctx=_l1_ctx())
+        try:
+            answers.append(h.lookup(keys))
+        finally:
+            h.close()
+    assert answers[0] == answers[1] == answers[2]
+
+
+def test_corrupt_block_is_checked_error_not_none(tmp_path):
+    """A key that RESOLVES but whose block fails crc/decode must raise
+    a checked Error — None is reserved for honest negative lookups."""
+    path = _write_corpus(str(tmp_path / "corrupt.rec"), "zlib")
+    with open(path, "r+b") as f:
+        size = os.path.getsize(path)
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    try:
+        with pytest.raises(Error):
+            # every record: some key's block is the corrupted one
+            h.lookup(list(range(0, 3 * N_RECORDS, 3)))
+    finally:
+        h.close()
+
+
+def test_string_keys_resolve(tmp_path):
+    path = str(tmp_path / "s.rec")
+    with FileStream(path, "w") as f, FileStream(path + ".idx", "w") as fi:
+        w = IndexedRecordIOWriter(f, fi, codec="zlib", block_bytes=512)
+        for i in range(50):
+            # the writer's key column is whatever the index stream got;
+            # write a non-numeric sidecar by hand below
+            w.write_record(b"val%03d" % i, key=i)
+        w.flush()
+    text = open(path + ".idx").read().split("\n")
+    with open(path + ".idx", "w") as f:
+        for line in text:
+            if line:
+                k, off = line.split("\t")
+                f.write(f"user-{int(k):03d}\t{off}\n")
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    try:
+        vals = h.lookup(["user-007", "user-000", "nope"])
+        assert vals[0] == b"val007"
+        assert vals[1] == b"val000"
+        assert vals[2] is None
+    finally:
+        h.close()
+
+
+def test_float_key_rejected_not_truncated(tmp_path):
+    """A float key truncating to a neighboring id must raise, never
+    return the wrong record (int(3.7) == 3 would)."""
+    path = _write_corpus(str(tmp_path / "fk.rec"), None, n=20)
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    try:
+        with pytest.raises(Error, match="must be integers"):
+            h.lookup([3.7])
+        assert h.lookup(["3"]) == [_payload(1)]  # exact wire form passes
+    finally:
+        h.close()
+
+
+def test_string_index_rejects_unrepresentable_keys(tmp_path):
+    """On a string-keyed index, bytes decode (the sidecar is text) and
+    ints render exactly — but a float str()-ing into a never-matching
+    key must raise, not masquerade as an honest negative."""
+    path = str(tmp_path / "sk.rec")
+    with FileStream(path, "w") as f, FileStream(path + ".idx", "w") as fi:
+        w = IndexedRecordIOWriter(f, fi)
+        for i in range(10):
+            w.write_record(b"val%d" % i, key=i)
+        w.flush()
+    text = open(path + ".idx").read().splitlines()
+    with open(path + ".idx", "w") as f:
+        for line in text:
+            k, off = line.split("\t")
+            f.write(f"user{k}\t{off}\n")
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    try:
+        assert h.lookup([b"user3", "user4"]) == [b"val3", b"val4"]
+        with pytest.raises(Error, match="must be strings"):
+            h.lookup([3.7])
+    finally:
+        h.close()
+
+
+def test_oversized_key_batch_is_checked_error(tmp_path):
+    """A key batch whose JSON header outgrows the control-frame cap is
+    rejected at the SENDER with a checked Error naming the cap — not a
+    dropped connection masquerading as a dead daemon."""
+    path = _write_corpus(str(tmp_path / "big.rec"), None, n=20)
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    srv = LookupServer(h, port=0)
+    try:
+        c = LookupClient("127.0.0.1", srv.port)
+        with pytest.raises(Error, match="frame cap|exceeds the"):
+            c.lookup(list(range(10**9, 10**9 + 200_000)))
+        # the connection survives (nothing was sent)
+        assert c.lookup([0]) == [_payload(0)]
+        c.close()
+    finally:
+        srv.close()
+        h.close()
+
+
+def test_duplicate_sidecar_key_fails_loudly(tmp_path):
+    """Regression (ISSUE 13 satellite): a duplicated index key used to
+    silently win by sort order — for point reads that is a wrong-record
+    hazard, so the loader rejects it."""
+    path = _write_corpus(
+        str(tmp_path / "dup.rec"), "zlib", key_fn=lambda i: min(i, 7)
+    )
+    with pytest.raises(Error, match="duplicate key"):
+        RecordLookup(path)
+
+
+def test_odd_index_token_count_fails_loudly(tmp_path):
+    path = _write_corpus(str(tmp_path / "odd.rec"), None)
+    with open(path + ".idx", "a") as f:
+        f.write("stray\n")
+    with pytest.raises(Error, match="odd token count"):
+        RecordLookup(path)
+
+
+def test_epoch_reader_unaffected_by_key_retention(tmp_path):
+    """The epoch path ignores keys entirely: an indexed drain over the
+    same shard still yields every record in file order."""
+    path = _write_corpus(str(tmp_path / "epoch.rec"), "zlib")
+    sp = io_split.IndexedRecordIOSplitter(path, path + ".idx", 0, 1)
+    try:
+        got = [bytes(r) for r in iter(sp.next_record, None)]
+    finally:
+        sp.close()
+    assert got == [_payload(i) for i in range(N_RECORDS)]
+
+
+def test_extract_payloads_native_matches_fallback(tmp_path, monkeypatch):
+    path = _write_corpus(str(tmp_path / "par.rec"), None, n=64)
+    data = np.frombuffer(open(path, "rb").read(), dtype=np.uint8)
+    # frame starts from the sidecar
+    offs = np.asarray(
+        [int(line.split()[1]) for line in open(path + ".idx")],
+        dtype=np.int64,
+    )
+    sizes = np.concatenate((np.diff(offs), [len(data) - offs[-1]]))
+    native_out = _extract_payloads(data, offs, sizes, "parity")
+    from dmlc_core_tpu.data import native as native_mod
+
+    monkeypatch.setattr(native_mod, "HAS_WALK_SPANS", False)
+    fallback_out = _extract_payloads(data, offs, sizes, "parity")
+    assert native_out == fallback_out
+    assert native_out == [_payload(i) for i in range(64)]
+
+
+def test_index_cache_eviction_counter(tmp_path, monkeypatch):
+    """ISSUE 13 satellite: the parsed-index LRU is bytes-bounded and its
+    evictions are a telemetry series, so a many-corpus serve daemon
+    shows index churn instead of silent RSS growth."""
+    from dmlc_core_tpu.telemetry import default_registry
+
+    # a budget big enough for one parsed index, not two (each ~1.4 KB)
+    monkeypatch.setattr(io_split, "_index_cache_budget", lambda: 2048)
+    ctr = default_registry().counter("io.split.index_cache_evictions")
+    before = ctr.value()
+    for i in range(3):
+        path = _write_corpus(str(tmp_path / f"m{i}.rec"), None, n=60)
+        h = RecordLookup(path, decode_ctx=_l1_ctx())
+        try:
+            assert h.lookup([0]) == [_payload(0)]
+        finally:
+            h.close()
+    assert ctr.value() > before
+    with io_split._INDEX_CACHE_LOCK:
+        assert len(io_split._INDEX_CACHE) <= 1
+
+
+# -- degradation matrix -------------------------------------------------------
+KEYSET = [0, 3, 231, 10**9, 3 * (N_RECORDS - 1), 300, 303, 306]
+
+
+@pytest.mark.blockcache
+def test_bit_identity_across_cache_tiers(tmp_path):
+    """Acceptance: lookup results bit-identical across {daemon on,
+    daemon dead, L1-only} × {v1, zlib} for the same key set."""
+    for codec in (None, "zlib"):
+        path = _write_corpus(
+            str(tmp_path / f"mtx_{codec or 'v1'}.rec"), codec
+        )
+        answers = {}
+        # L1-only
+        h = RecordLookup(path, decode_ctx=_l1_ctx())
+        answers["l1"] = h.lookup(KEYSET)
+        h.close()
+        # daemon on
+        d = BlockCacheDaemon(
+            str(tmp_path / f"bc_{codec or 'v1'}.sock"), max_bytes=64 << 20
+        ).start()
+        try:
+            ctx = io_codec.DecodeContext(
+                cache=io_codec.DecodedBlockCache(64 << 20),
+                shared=BlockCacheClient(d.sock_path),
+            )
+            h = RecordLookup(path, decode_ctx=ctx)
+            answers["daemon"] = h.lookup(KEYSET)
+            # daemon DEAD mid-handle: a fresh L1 forces re-reads, the
+            # dead client degrades to misses silently
+            d.close()
+            ctx2 = io_codec.DecodeContext(
+                cache=io_codec.DecodedBlockCache(64 << 20),
+                shared=BlockCacheClient(d.sock_path),
+            )
+            h2 = RecordLookup(path, decode_ctx=ctx2)
+            answers["dead"] = h2.lookup(KEYSET)
+            h.close()
+            h2.close()
+        finally:
+            d.close()
+        assert answers["l1"] == answers["daemon"] == answers["dead"]
+        assert answers["l1"][3] is None  # the negative stays negative
+
+
+@pytest.mark.blockcache
+def test_warm_publishes_through_daemon(tmp_path):
+    """warm() fetches+publishes the hot blocks; a SECOND process-shape
+    (fresh L1, same daemon) then serves the whole key set with ZERO
+    file reads — the shared tier did the work once."""
+    path = _write_corpus(str(tmp_path / "warm.rec"), "zlib")
+    d = BlockCacheDaemon(
+        str(tmp_path / "warm.sock"), max_bytes=64 << 20
+    ).start()
+    try:
+        ctx_a = io_codec.DecodeContext(
+            cache=io_codec.DecodedBlockCache(64 << 20),
+            shared=BlockCacheClient(d.sock_path),
+        )
+        h_a = RecordLookup(path, decode_ctx=ctx_a)
+        warmed = h_a.warm(KEYSET)
+        assert warmed > 0
+        assert h_a.warm(KEYSET) == 0  # already resident
+        h_a.close()
+        ctx_b = io_codec.DecodeContext(
+            cache=io_codec.DecodedBlockCache(64 << 20),
+            shared=BlockCacheClient(d.sock_path),
+        )
+        h_b = RecordLookup(path, decode_ctx=ctx_b)
+        vals = h_b.lookup(KEYSET)
+        assert vals[0] == _payload(0)
+        assert h_b.io_stats()["spans"] == 0  # zero reads: all from L2
+        h_b.close()
+    finally:
+        d.close()
+
+
+# -- serve daemon -------------------------------------------------------------
+def test_serve_daemon_end_to_end(tmp_path):
+    path = _write_corpus(str(tmp_path / "srv.rec"), "zlib")
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    srv = LookupServer(h, port=0)
+    try:
+        c = LookupClient("127.0.0.1", srv.port)
+        assert c.ping()
+        vals = c.lookup(KEYSET)
+        assert vals[0] == _payload(0)
+        assert vals[2] == _payload(77)
+        assert vals[3] is None
+        assert c.warm(max_blocks=4) >= 0
+        # two clients at once: batches serialize on the handle lock
+        c2 = LookupClient("127.0.0.1", srv.port)
+        assert c2.lookup([0]) == [_payload(0)]
+        st = c.stats()
+        assert st["requests"] >= 4
+        assert st["qps"] > 0
+        assert "p99_ms" in st and "p50_ms" in st
+        assert st["shard"]["records"] == N_RECORDS
+        assert st["negatives"] >= 1
+        c2.close()
+        c.close()
+    finally:
+        srv.close()
+        h.close()
+
+
+def test_serve_daemon_reports_corrupt_as_error(tmp_path):
+    path = _write_corpus(str(tmp_path / "srvbad.rec"), "zlib")
+    with open(path, "r+b") as f:
+        f.seek(os.path.getsize(path) // 2)
+        f.write(b"\xff\xff\xff\xff")
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    srv = LookupServer(h, port=0)
+    try:
+        c = LookupClient("127.0.0.1", srv.port)
+        with pytest.raises(Error, match="refused"):
+            c.lookup(list(range(0, 3 * N_RECORDS, 3)))
+        # the connection survives a refused request
+        assert c.ping()
+        c.close()
+    finally:
+        srv.close()
+        h.close()
+
+
+def test_malformed_key_shapes_refused_not_iterated(tmp_path):
+    """A scalar JSON string for keys would iterate char-by-char into
+    VALID keys and answer wrong records; bools are ints to Python and
+    would read key 0/1. Both must be checked refusals."""
+    path = _write_corpus(str(tmp_path / "shape.rec"), None, n=20)
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    srv = LookupServer(h, port=0)
+    try:
+        with pytest.raises(Error, match="must be integers"):
+            h.lookup([True])
+        c = LookupClient("127.0.0.1", srv.port)
+        with pytest.raises(Error, match="must be a JSON array"):
+            c._request({"op": "lookup", "keys": "12"})
+        with pytest.raises(Error, match="must be a JSON array"):
+            c._request({"op": "warm", "keys": "12"})
+        assert c.ping()  # the connection survives the refusals
+        c.close()
+    finally:
+        srv.close()
+        h.close()
+
+
+def test_serve_daemon_unknown_op_refused(tmp_path):
+    path = _write_corpus(str(tmp_path / "srvun.rec"), None, n=20)
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    srv = LookupServer(h, port=0)
+    try:
+        c = LookupClient("127.0.0.1", srv.port)
+        with pytest.raises(Error, match="unknown op"):
+            c._request({"op": "evil"})
+        c.close()
+    finally:
+        srv.close()
+        h.close()
+
+
+def test_tools_info_reports_shard_geometry(tmp_path, capsys):
+    import json
+
+    path = _write_corpus(str(tmp_path / "info.rec"), "zlib")
+    assert tools_main(["info", path]) == 0
+    report = json.loads(capsys.readouterr().out)
+    shard = report["shard"]
+    assert shard["records"] == N_RECORDS
+    assert shard["keys"] == N_RECORDS
+    assert shard["compressed"] is True
+    assert shard["codec"] == "zlib"
+    assert shard["blocks"] > 1
+    assert shard["block_bytes"]["min"] <= shard["block_bytes"]["max"]
+
+
+def test_lookup_telemetry_series_tick(tmp_path):
+    from dmlc_core_tpu.telemetry import default_registry
+
+    reg = default_registry()
+    b0 = reg.counter("io.lookup.batches").value()
+    n0 = reg.counter("io.lookup.negatives").value()
+    path = _write_corpus(str(tmp_path / "tel.rec"), "zlib", n=40)
+    h = RecordLookup(path, decode_ctx=_l1_ctx())
+    try:
+        h.lookup([0, 10**9])
+    finally:
+        h.close()
+    assert reg.counter("io.lookup.batches").value() == b0 + 1
+    assert reg.counter("io.lookup.negatives").value() == n0 + 1
+    snap = reg.snapshot()["histograms"]
+    assert "io.lookup.batch_seconds" in snap

@@ -114,6 +114,16 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  sizing and the budget; route decode
                                  work through codec's pool and remote
                                  reads through SpanFetcher.)
+  L016 socket-serving request loops in dmlc_core_tpu/io/ (exactly two
+                                 modules are sanctioned servers there:
+                                 blockcache.py — the shared-cache
+                                 control plane — and lookup.py — the
+                                 point-read serve daemon. A listen/
+                                 accept/create_server elsewhere in io/
+                                 forks connection lifecycle, frame
+                                 hygiene and teardown policy per call
+                                 site; serve through those two or live
+                                 outside io/.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -368,10 +378,15 @@ def _check_codec_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
 _L006_EXEMPT = ("/io/retry.py",)
 # files allowed to import compression modules directly: the codec layer
 _L009_EXEMPT = ("/io/codec.py",)
-# L010 is SCOPED to dmlc_core_tpu/io/ and exempts the block-cache
-# service, which owns the single shm+socket site
+# L010 is SCOPED to dmlc_core_tpu/io/ and exempts the two sanctioned
+# wire services: the block-cache daemon (shm + UNIX socket) and the
+# point-read serve daemon (TCP request loop, io/lookup.py)
 _L010_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
-_L010_EXEMPT = ("/io/blockcache.py",)
+_L010_EXEMPT = ("/io/blockcache.py", "/io/lookup.py")
+# L016 is scoped to dmlc_core_tpu/io/ and exempts the same two files —
+# the only modules allowed to RUN a socket-serving request loop there
+_L016_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
+_L016_EXEMPT = ("/io/blockcache.py", "/io/lookup.py")
 # trees allowed to call jax.device_put directly: the staging layer owns
 # the transfer call sites; tests build device-resident fixtures.
 # Anchored against the REPO-RELATIVE path (a checkout living under e.g.
@@ -634,6 +649,51 @@ def _check_struct_framing(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+def _check_socket_serving_loops(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call that makes a module a socket SERVER — ``.listen(...)``
+    or ``.accept(...)`` on any object, or ``socket.create_server(...)``
+    under any module alias (incl. the bare name bound by ``from socket
+    import create_server``): inside dmlc_core_tpu/io/ exactly two
+    request loops are sanctioned — the block-cache control plane
+    (io/blockcache.py) and the point-read serve daemon (io/lookup.py),
+    the L006/L008-L015 single-site pattern. Scoped in lint_file.
+    Dialing out (connect/create_connection) is L010's business, not
+    this rule's."""
+    fn_aliases = set()
+    mod_aliases = {"socket"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "socket":
+            for alias in node.names:
+                if alias.name == "create_server":
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "socket":
+                    mod_aliases.add(alias.asname or "socket")
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+            isinstance(f, ast.Attribute)
+            and (
+                f.attr in ("accept", "listen")
+                or (
+                    f.attr == "create_server"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in mod_aliases
+                )
+            )
+        )
+        if hit:
+            yield node.lineno, (
+                "socket-serving request loop in io/ (servers there are "
+                "confined to io/blockcache.py and io/lookup.py — a "
+                "third loop forks connection lifecycle and frame "
+                "hygiene per site)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -650,6 +710,7 @@ CHECKS = [
     ("L013", _check_rendezvous_cmd_literals),
     ("L014", _check_socket_construction),
     ("L015", _check_struct_framing),
+    ("L016", _check_socket_serving_loops),
 ]
 
 
@@ -740,6 +801,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L015_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L015_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L016":
+            if posix.endswith(_L016_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L016_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L016_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
