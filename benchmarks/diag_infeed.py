@@ -173,6 +173,13 @@ def main():
         )
     bench.ensure_native()
     bench.ensure_rec_data()
+    # 1 s registry sampling for the whole run: the exit summary prints
+    # last-30s windowed rows/s + stall fractions next to the cumulative
+    # A-F sums (a tail stall is invisible in whole-run averages)
+    from dmlc_core_tpu.telemetry import timeseries as _timeseries
+
+    ts_ring = _timeseries.TimeSeriesRing(interval=1.0)
+    ts_ring.start()
     import jax
 
     jax.local_devices()  # warm the backend outside any timer
@@ -189,6 +196,8 @@ def main():
         nbatches = out[f"A_staged_{r}"]["batches"]
         out[f"D_raw_{r}"] = bench.raw_infeed_probe(nb, nbatches)
     print(json.dumps(out, indent=1, default=float))
+    ts_ring.sample()  # reach "now" before the windowed query
+    print(_timeseries.summary_line(ts_ring.window(30.0)))
     # exit dump of the telemetry registry: the same epochs as stage
     # duration HISTOGRAMS (p50/p90/p99 per stage) next to the A-F sums
     from dmlc_core_tpu.telemetry import to_json as telemetry_snapshot

@@ -277,13 +277,39 @@ def dsserve_drain(fault: str = ""):
                 os.environ[k] = v
 
 
+_TS_RING = None
+
+
+def _start_timeseries() -> None:
+    """Sample the registry every second for the run so the exit summary
+    can print WINDOWED rates (last-30s rows/s + stall fractions) next
+    to the cumulative totals — a long diag drain's tail behavior is
+    otherwise averaged away by the whole-run numbers."""
+    global _TS_RING
+    from dmlc_core_tpu.telemetry import timeseries
+
+    _TS_RING = timeseries.TimeSeriesRing(interval=1.0)
+    _TS_RING.start()
+
+
+def _print_windowed() -> None:
+    if _TS_RING is None:
+        return
+    from dmlc_core_tpu.telemetry import timeseries
+
+    _TS_RING.sample()  # reach "now" before querying
+    print(timeseries.summary_line(_TS_RING.window(30.0)))
+
+
 def _print_telemetry() -> None:
     """Exit dump of the process telemetry registry: every counter the
     drained layers ticked (split shape, retry/fault, staging) in one
     place — starvation diagnosis no longer means grepping the scattered
-    per-mode io_stats dicts above it."""
+    per-mode io_stats dicts above it; the windowed line on top of it
+    answers 'what was it doing at the END' (docs/observability.md)."""
     from dmlc_core_tpu.telemetry import to_json
 
+    _print_windowed()
     print("telemetry: " + json.dumps(to_json()))
 
 
@@ -362,6 +388,7 @@ def _dump_trace(path) -> None:
 def main():
     trace_path = _trace_arg()
     _fetch_threads_arg()
+    _start_timeseries()
     if "--shuffle" in sys.argv:
         fault = ""
         if "--fault" in sys.argv:  # e.g. --fault resets=2,errors=1,seed=7

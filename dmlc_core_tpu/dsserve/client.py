@@ -50,6 +50,7 @@ from ..io.split import fileset_signature
 from ..io.uri import URISpec
 from ..staging.batcher import Batch, BatchSpec
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from ..utils.logging import Error, check
 from ..utils.profiler import annotate
 from . import wire
@@ -232,7 +233,13 @@ class DsServeBatches:
             (host, port), timeout=self._connect_timeout
         )
         try:
-            wire.send_frame(sock, wire.KIND_HELLO, self._hello(i, start_seq))
+            hello = self._hello(i, start_seq)
+            # causal link: the server's stream-setup handler span binds
+            # to this client's connect (telemetry/tracing.py flows)
+            tc = _tracing.rpc_context()
+            if tc:
+                hello["tc"] = tc
+            wire.send_frame(sock, wire.KIND_HELLO, hello)
             kind, meta, _p, _s, _e = wire.recv_frame(sock)
             if kind == wire.KIND_ERROR:
                 raise Error(
@@ -292,10 +299,10 @@ class DsServeBatches:
                 complete = bool(resp.get("epoch_complete"))
             if status == "recorded":
                 self.shards_recorded += 1
-                for batch, seq in pending:
+                for batch, seq, tc in pending:
                     if self.on_slot is not None:
                         self.on_slot(shard, seq, batch.packed)
-                    if not self._put(("batch", batch)):
+                    if not self._put(("batch", batch, tc)):
                         return
             else:
                 self.shards_duplicate += 1
@@ -391,11 +398,11 @@ class DsServeBatches:
                             f"dsserve: interleaved shards on one stream "
                             f"({pending_shard} then {shard})"
                         )
-                    pending.append((batch, seq))
+                    pending.append((batch, seq, meta.get("tc")))
                 else:
                     if self.on_slot is not None:
                         self.on_slot(shard, seq, batch.packed)
-                    if not self._put(("batch", batch)):
+                    if not self._put(("batch", batch, meta.get("tc"))):
                         return
                     st.delivered += 1
             elif kind == wire.KIND_SHARD_FIN:
@@ -438,6 +445,11 @@ class DsServeBatches:
             t0 = time.perf_counter()
             with annotate("dmlc:dsserve_recv_wait"):
                 item = self._out.get()
+                if item[0] == "batch" and len(item) > 2:
+                    # land the server's slot flow INSIDE the wait span:
+                    # the merged timeline shows which remote stream
+                    # produced the slot this consumer was starved for
+                    _tracing.handler_flow(item[2])
             dt = time.perf_counter() - t0
             self.recv_wait_secs += dt
             _RECV_WAIT.observe(dt)

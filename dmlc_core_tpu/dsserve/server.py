@@ -279,14 +279,21 @@ class DsServeServer:
             kind, meta, _payload, _seq, _ep = wire.recv_frame(conn)
             if kind != wire.KIND_HELLO:
                 raise Error(f"dsserve: expected HELLO, got frame kind {kind}")
-            cfg = _StreamConfig(meta)
-            # a deadline, not None: a stalled (not disconnected) client
-            # must fail the stream loudly instead of wedging it forever
-            conn.settimeout(default_send_timeout())
-            wire.send_frame(
-                conn, wire.KIND_OK,
-                {"mode": cfg.mode, "rank": self.rank, "pid": os.getpid()},
-            )
+            # stream setup under a handler span carrying the client's
+            # trace context (HELLO meta "tc"): the merged timeline
+            # binds it to the trainer's connect
+            with _tracing.handler_span(
+                "dmlc:dsserve_hello", meta.get("tc"), peer=str(addr)
+            ):
+                cfg = _StreamConfig(meta)
+                # a deadline, not None: a stalled (not disconnected)
+                # client must fail the stream loudly instead of
+                # wedging it forever
+                conn.settimeout(default_send_timeout())
+                wire.send_frame(
+                    conn, wire.KIND_OK,
+                    {"mode": cfg.mode, "rank": self.rank, "pid": os.getpid()},
+                )
             if cfg.mode == "lease":
                 self._stream_leased(conn, cfg)
             else:
@@ -360,6 +367,12 @@ class DsServeServer:
 
     def _send_one(self, conn, batch, shard: int, epoch: int, seq: int) -> int:
         meta = wire.slot_meta(batch, shard)
+        # each slot carries the server's flow id: the trainer lands it
+        # inside its dsserve_recv_wait span, so a starved consumer's
+        # timeline points at the stream (and span) that fed it
+        tc = _tracing.rpc_context()
+        if tc:
+            meta["tc"] = tc
         sent = wire.send_frame(
             conn, wire.KIND_SLOT, meta, batch.packed, seq=seq, epoch=epoch
         )

@@ -491,10 +491,14 @@ class BlockCacheDaemon:
         }
 
     def _handle(self, req: dict, held: set) -> Optional[dict]:
-        # per-op span on the daemon's connection thread: the merged
-        # timeline shows lookup/publish/flush service time next to the
-        # client windows waiting on them (op names are a bounded set)
-        with _tracing.span(f"dmlc:blockcache_{req.get('op')}"):
+        # per-op HANDLER span on the daemon's connection thread: the
+        # merged timeline shows lookup/publish/flush service time next
+        # to the client windows waiting on them (op names are a bounded
+        # set), with a flow arrow from the requesting span (the "tc"
+        # trace context the client piggybacks on the control frame)
+        with _tracing.handler_span(
+            f"dmlc:blockcache_{req.get('op')}", req.get("tc")
+        ):
             return self._handle_inner(req, held)
 
     def _handle_inner(self, req: dict, held: set) -> Optional[dict]:
@@ -715,6 +719,12 @@ class BlockCacheClient:
                 return None, False
             sent = False
             try:
+                # causal link: the daemon's per-op handler span binds
+                # to whatever span encloses this request (a window
+                # loader's miss path, a lookup batch)
+                tc = _tracing.rpc_context()
+                if tc:
+                    obj = {**obj, "tc": tc}
                 _send_frame(self._sock, obj)
                 sent = True
                 if oneway:

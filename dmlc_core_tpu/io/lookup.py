@@ -741,7 +741,9 @@ class LookupServer:
 
     def _handle(self, op: str, req: dict) -> Tuple[dict, bytes]:
         span = f"dmlc:lookup_{op if op in self._OPS else 'unknown'}"
-        with _tracing.span(span):
+        # handler span carrying the client's trace context: the flow
+        # arrow from the caller's lookup_wait lands here
+        with _tracing.handler_span(span, req.get("tc")):
             if op == "ping":
                 return {"ok": True, "pid": os.getpid()}, b""
             if op == "lookup":
@@ -854,8 +856,14 @@ class LookupClient:
         with self._lock:
             sock = self._connect_locked()
             try:
-                _send_frame(sock, obj)
+                # the wait span encloses the SEND too so the request's
+                # flow-start lands inside it: every lookup_wait slice
+                # gets its causal arrow to the daemon's handler span
                 with _tracing.span("dmlc:lookup_wait"):
+                    tc = _tracing.rpc_context()
+                    if tc:
+                        obj = {**obj, "tc": tc}
+                    _send_frame(sock, obj)
                     resp = _recv_frame(sock)
                     payload = b""
                     if want_payload and resp.get("ok"):

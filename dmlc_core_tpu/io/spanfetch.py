@@ -391,11 +391,12 @@ class SpanFetcher:
                 _INFLIGHT.inc(nbytes)
                 if state["inflight"] > self.concurrency_peak:
                     self.concurrency_peak = state["inflight"]
-                    # the gauge is the PROCESS max: only raise it, so a
-                    # later low-concurrency fetcher can't clobber an
-                    # earlier fetcher's true peak
-                    if self.concurrency_peak > _PEAK.value():
-                        _PEAK.set(self.concurrency_peak)
+                    # high-water mark: the gauge only rises within a
+                    # measurement scope, so a later low-concurrency
+                    # fetcher can't clobber an earlier fetcher's true
+                    # peak — and reset_peak_gauges() rewinds it at
+                    # scope boundaries (per bench config)
+                    _PEAK.set_max(self.concurrency_peak)
                 self._pool.submit(worker, si, begin, nbytes)
 
         submit_ready()

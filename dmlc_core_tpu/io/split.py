@@ -2408,8 +2408,22 @@ class DynamicShardSource(InputSplit):
         while self._split is None:
             if self._exhausted:
                 return False
-            resp = self._client.lease(self.epoch, self._fileset)
-            status = resp.get("status")
+            # the lease RPC (and any "come back later" backoff) IS the
+            # wait: recording both under the stall span means every
+            # shard_lease_wait slice encloses the request's flow-start,
+            # so a merged timeline draws the arrow straight to the
+            # tracker's shard_lease handler span (docs/observability.md)
+            with annotate("dmlc:shard_lease_wait"):
+                resp = self._client.lease(self.epoch, self._fileset)
+                status = resp.get("status")
+                if status == "wait":
+                    # every micro-shard is leased out: park (visibly —
+                    # this IS the straggler signal on a merged
+                    # timeline) until one completes or a lease expires
+                    # and is reclaimed
+                    backoff = float(resp.get("backoff", 0.1))
+                    time.sleep(min(1.0, max(0.01, backoff)))
+                    self.lease_wait_secs += backoff
             if status == "lease":
                 shard = int(resp["shard"])
                 self.num_shards = int(resp["num_shards"])
@@ -2426,13 +2440,7 @@ class DynamicShardSource(InputSplit):
                 if self.on_lease is not None:
                     self.on_lease(shard, self.num_shards)
             elif status == "wait":
-                # every micro-shard is leased out: park (visibly — this
-                # IS the straggler signal on a merged timeline) until
-                # one completes or a lease expires and is reclaimed
-                backoff = float(resp.get("backoff", 0.1))
-                with annotate("dmlc:shard_lease_wait"):
-                    time.sleep(min(1.0, max(0.01, backoff)))
-                self.lease_wait_secs += backoff
+                pass  # already parked inside the stall span above
             elif status == "done":
                 self._exhausted = True
                 return False

@@ -14,9 +14,15 @@ Three layers (docs/observability.md):
   heartbeat snapshots, served over a local HTTP ``/metrics`` endpoint
   and an end-of-job JSON report.
 - **tracing** — the flight recorder (ISSUE 8): always-on per-thread
-  span rings with Chrome/Perfetto export, cross-process merge and
-  stall attribution; the TIMELINE tier next to the registry's
-  aggregates (``profiler.annotate`` feeds both).
+  span rings with Chrome/Perfetto export, cross-process merge, stall
+  attribution and causal RPC flow events (ISSUE 14); the TIMELINE
+  tier next to the registry's aggregates (``profiler.annotate`` feeds
+  both).
+- **timeseries** — windowed rates (ISSUE 14): a bounded per-process
+  ring of timestamped registry samples, shipped incrementally on
+  tracker heartbeats into a cluster store; ``/metrics.json?window=N``
+  answers "rows/s and stall fraction over the last N seconds", which
+  is what ``tools top`` renders and a future autoscaler consumes.
 
 Producers migrated onto it: ``io/retry.py`` (retry/backoff/fault
 counters — ``io_stats()`` stays a bit-compatible view), ``io/split.py``
@@ -24,9 +30,10 @@ counters — ``io_stats()`` stays a bit-compatible view), ``io/split.py``
 histograms), ``utils/profiler.annotate`` (opt-in span histograms).
 """
 
+from . import timeseries as timeseries
 from . import tracing as tracing
 from .aggregate import ClusterAggregator, merge_snapshots, serve_metrics
-from .export import Reporter, to_json, to_prometheus
+from .export import Reporter, serve_metrics_http, to_json, to_prometheus
 from .registry import (
     Counter,
     Gauge,
@@ -52,7 +59,9 @@ __all__ = [
     "merge_snapshots",
     "render_key",
     "serve_metrics",
+    "serve_metrics_http",
     "split_key",
+    "timeseries",
     "to_json",
     "to_prometheus",
     "tracing",

@@ -26,11 +26,13 @@ import json
 import logging
 import math
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
 
-from .export import to_prometheus
+from .export import _ClosableHTTPServer, to_prometheus
 from .registry import render_key, split_key
+from .timeseries import ClusterTimeSeries
 
 __all__ = ["ClusterAggregator", "merge_snapshots", "serve_metrics"]
 
@@ -150,11 +152,18 @@ class ClusterAggregator:
         self._lock = threading.Lock()
         self._by_rank: Dict[int, Snapshot] = {}
         self.updates = 0
+        #: per-rank windowed sample store fed by the heartbeats'
+        #: ``timeseries`` key (telemetry/timeseries.py); the tracker's
+        #: own registry samples ride it under the ``tracker`` pseudo-
+        #: rank (how queue-depth history reaches /metrics.json?window=)
+        self.timeseries = ClusterTimeSeries()
 
     def update(self, rank: int, payload) -> None:
         """Record ``payload`` (a snapshot dict or its JSON string) as
-        rank's latest. Malformed payloads are dropped with a warning —
-        a worker's bad heartbeat must never hurt the tracker."""
+        rank's latest; its ``timeseries`` key (new ring samples since
+        the last heartbeat) feeds the per-rank sample store. Malformed
+        payloads are dropped with a warning — a worker's bad heartbeat
+        must never hurt the tracker."""
         if isinstance(payload, (str, bytes)):
             try:
                 payload = json.loads(payload)
@@ -164,6 +173,9 @@ class ClusterAggregator:
         if not isinstance(payload, dict):
             logger.warning("rank %d sent non-dict metrics", rank)
             return
+        samples = payload.get("timeseries")
+        if samples is not None:
+            self.timeseries.add(int(rank), samples)
         clean = _sanitize(payload)
         with self._lock:
             self._by_rank[int(rank)] = clean
@@ -176,14 +188,31 @@ class ClusterAggregator:
     def cluster(self) -> Snapshot:
         return merge_snapshots(list(self.per_rank().values()))
 
-    def report(self) -> Dict[str, Any]:
-        """End-of-job shape: cluster totals + per-rank snapshots."""
+    def windowed(self, seconds: float) -> Dict[str, Any]:
+        """Windowed rates per rank + cluster over the sample store
+        (the ``/metrics.json?window=N`` body's ``windowed`` key)."""
+        return self.timeseries.window(seconds)
+
+    def report(self, window: Optional[float] = None) -> Dict[str, Any]:
+        """End-of-job shape: cluster totals + per-rank snapshots + the
+        full retained time series (the trajectory BENCH runs diff).
+        ``window`` swaps the heavy full series for the live
+        windowed-rate view — the ``?window=`` polls a dashboard issues
+        every couple of seconds only read ``windowed``, and
+        re-serializing minutes of full snapshots per refresh would tax
+        the tracker for bytes nobody reads (the plain ``/metrics.json``
+        and the end-of-job report keep the full series)."""
         by_rank = self.per_rank()
-        return {
+        out = {
             "n_ranks": len(by_rank),
             "cluster": merge_snapshots(list(by_rank.values())),
             "per_rank": {str(r): s for r, s in sorted(by_rank.items())},
         }
+        if window is not None:
+            out["windowed"] = self.windowed(window)
+        else:
+            out["timeseries"] = self.timeseries.report()
+        return out
 
     def prometheus(self) -> str:
         """One VALID scrape body: cluster totals (unlabeled) and
@@ -208,13 +237,27 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     aggregator: ClusterAggregator  # set by serve_metrics on the subclass
 
     def do_GET(self) -> None:  # noqa: N802 (http.server contract)
-        path = self.path.split("?", 1)[0]
+        parts = urlsplit(self.path)
+        path = parts.path
         try:
             if path == "/metrics":
                 body = self.aggregator.prometheus().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
             elif path in ("/metrics.json", "/json"):
-                body = json.dumps(self.aggregator.report()).encode()
+                # ?window=SECONDS adds the windowed-rate view computed
+                # over the per-rank sample store (docs/observability.md
+                # "Time series"); a malformed value degrades to the
+                # plain report instead of a 500
+                window = None
+                raw = parse_qs(parts.query).get("window")
+                if raw:
+                    try:
+                        window = max(0.001, float(raw[0]))
+                    except ValueError:
+                        window = None
+                body = json.dumps(
+                    self.aggregator.report(window=window)
+                ).encode()
                 ctype = "application/json"
             else:
                 self.send_response(404)
@@ -240,14 +283,14 @@ def serve_metrics(
     aggregator: ClusterAggregator,
     host: str = "127.0.0.1",
     port: int = 0,
-) -> Tuple[ThreadingHTTPServer, int]:
+) -> Tuple[_ClosableHTTPServer, int]:
     """Start the loopback metrics endpoint on a daemon thread; returns
-    (server, bound_port). ``server.shutdown()`` stops it."""
+    (server, bound_port). ``server.shutdown()`` + ``server_close()``
+    stop it (both idempotent — export.py's _ClosableHTTPServer)."""
     handler = type(
         "_BoundMetricsHandler", (_MetricsHandler,), {"aggregator": aggregator}
     )
-    server = ThreadingHTTPServer((host, port), handler)
-    server.daemon_threads = True
+    server = _ClosableHTTPServer((host, port), handler)
     threading.Thread(
         target=server.serve_forever, daemon=True, name="metrics-http"
     ).start()

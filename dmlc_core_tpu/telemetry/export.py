@@ -14,6 +14,7 @@ import logging
 import math
 import threading
 import time
+from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Dict, Optional, TextIO, Union
 
 from .registry import MetricRegistry, default_registry, render_key, split_key
@@ -138,6 +139,27 @@ def to_prometheus(
     return "\n".join(lines) + "\n"
 
 
+class _ClosableHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with an IDEMPOTENT ``server_close``:
+    teardown paths race (atexit + explicit close, a daemon's SIGTERM
+    handler + its finally block), and a second ``server_close`` on a
+    vanilla server would close an fd the OS may have already handed to
+    someone else. ``shutdown()`` is already safe to repeat; this makes
+    the close half match (the tracker-exporter idempotence contract)."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs) -> None:
+        self._dmlc_closed = False
+        super().__init__(*args, **kwargs)
+
+    def server_close(self) -> None:
+        if self._dmlc_closed:
+            return
+        self._dmlc_closed = True
+        super().server_close()
+
+
 def serve_metrics_http(
     port: int,
     registry: Optional[MetricRegistry] = None,
@@ -150,9 +172,9 @@ def serve_metrics_http(
     its own handler. Serves Prometheus text on ``/metrics`` and, when
     ``json_provider`` is given, its dict as JSON on ``/metrics.json``,
     ``/json`` and ``/stats``. Render failures answer 500 per request,
-    never kill the server thread. Returns the started
-    ``ThreadingHTTPServer`` (caller shuts it down)."""
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    never kill the server thread. Returns the started server
+    (``shutdown()`` + ``server_close()`` to stop; both idempotent)."""
+    from http.server import BaseHTTPRequestHandler
 
     reg = registry or default_registry()
 
@@ -186,8 +208,7 @@ def serve_metrics_http(
         def log_message(self, fmt: str, *args) -> None:
             logger.debug("metrics http: " + fmt, *args)
 
-    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
-    server.daemon_threads = True
+    server = _ClosableHTTPServer(("127.0.0.1", port), _Handler)
     threading.Thread(
         target=server.serve_forever, daemon=True, name=name
     ).start()

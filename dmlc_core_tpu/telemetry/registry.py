@@ -203,11 +203,38 @@ class Gauge:
         self._lock = threading.Lock()
         self._value = 0.0
         self._fn: Optional[Callable[[], float]] = None
+        self._peak = False  # ever written through set_max()
 
     def set(self, v: float) -> None:
         with self._lock:
             self._fn = None
             self._value = float(v)
+
+    def set_max(self, v: float) -> None:
+        """High-water-mark write: keep the larger of current and ``v``
+        and mark this gauge peak-style, so ``reset_max()`` /
+        ``MetricRegistry.reset_peak_gauges()`` can rewind it between
+        measurement scopes (bench configs). A plain ``set`` race
+        between two writers would lose the larger reading; this is the
+        one atomic compare-and-keep site."""
+        with self._lock:
+            self._peak = True
+            self._fn = None
+            v = float(v)
+            if v > self._value:
+                self._value = v
+
+    def reset_max(self) -> None:
+        """Rewind a peak-style gauge to 0 (no-op on gauges never
+        written through ``set_max`` — live inc/dec accounting must not
+        be zeroed by a scope reset)."""
+        with self._lock:
+            if self._peak:
+                self._value = 0.0
+
+    def is_peak(self) -> bool:
+        with self._lock:
+            return self._peak
 
     def inc(self, n: float = 1) -> None:
         with self._lock:
@@ -563,6 +590,36 @@ class MetricRegistry:
                     continue
                 out[key] = metric.value()
         return out
+
+    def _peak_gauges(self, prefix: str = "") -> List[Tuple[str, Gauge]]:
+        with self._lock:
+            items = [
+                (name, dict(fam.children))
+                for name, fam in self._families.items()
+                if fam.kind == "gauge"
+                and (not prefix or name.startswith(prefix))
+            ]
+        out: List[Tuple[str, Gauge]] = []
+        for name, children in items:
+            for lkey, g in children.items():
+                if g.is_peak():
+                    out.append((render_key(name, dict(lkey)), g))
+        return out
+
+    def peak_gauge_values(self, prefix: str = "") -> Dict[str, float]:
+        """Current values of every ``set_max``-style gauge (the
+        per-config peaks the bench report records)."""
+        return {k: g.value() for k, g in self._peak_gauges(prefix)}
+
+    def reset_peak_gauges(self, prefix: str = "") -> int:
+        """Rewind every peak-style gauge in the subtree to 0; returns
+        how many were rewound. The scope boundary for high-water marks
+        (``io.fetch.concurrency_peak`` et al.): without it, the first
+        bench config's peak shadows every later config's."""
+        gauges = self._peak_gauges(prefix)
+        for _k, g in gauges:
+            g.reset_max()
+        return len(gauges)
 
     def help_for(self, name: str) -> str:
         with self._lock:

@@ -53,6 +53,7 @@ from __future__ import annotations
 import atexit
 import json
 import os
+import random
 import signal
 import sys
 import threading
@@ -63,16 +64,25 @@ __all__ = [
     "TraceRing",
     "add_complete",
     "begin",
+    "clock_offset_ns",
     "counter",
+    "decode_context",
     "default_trace_path",
     "dump",
     "enabled",
+    "encode_context",
     "end",
+    "flow_recv",
+    "flow_send_id",
+    "handler_flow",
+    "handler_span",
     "install_signal_dump",
     "instant",
     "load_trace",
     "merge_traces",
     "reset",
+    "rpc_context",
+    "set_clock_offset",
     "set_enabled",
     "set_process_label",
     "span",
@@ -229,7 +239,51 @@ def _ring() -> TraceRing:
 # -- recording API -------------------------------------------------------------
 # Event tuples: ("X", name, t0_ns, dur_ns, args) complete span,
 #               ("i", name, ts_ns, 0, args) instant,
-#               ("C", name, ts_ns, value, None) counter sample.
+#               ("C", name, ts_ns, value, None) counter sample,
+#               ("s"/"f", name, ts_ns, flow_id, None) flow start/finish
+#               (the causal arrows binding a client wait span to the
+#               remote handler span that answers it).
+
+
+# wait-stage span durations are ALSO mirrored into the metric registry
+# (``trace.stall_seconds{stage=...}`` counters) so the time-series layer
+# (telemetry/timeseries.py) can answer "what stall fraction over the
+# last 30 s" without a trace dump — the registry is the windowed-rate
+# substrate, the ring stays the timeline. Memoized per span name; one
+# dict hit per completed NON-wait span, one thread-local counter add
+# per wait span (both well inside the <=3% bench overhead budget).
+_STALL_COUNTERS: Dict[str, Optional[Any]] = {}
+_STALL_LOCK = threading.Lock()
+
+
+def _stall_counter(name: str):
+    try:
+        return _STALL_COUNTERS[name]
+    except KeyError:
+        pass
+    stage = _stage_name(name)
+    ctr = None
+    if stage in _WAIT_STAGES:
+        from .registry import default_registry
+
+        ctr = default_registry().counter(
+            "trace.stall_seconds",
+            help="cumulative wait-stage span seconds (flight recorder "
+            "mirror; the windowed stall-fraction source)",
+            labels={"stage": stage},
+        )
+    with _STALL_LOCK:
+        _STALL_COUNTERS.setdefault(name, ctr)
+    return ctr
+
+
+def _record_complete(
+    name: str, t0_ns: int, dur_ns: int, args: Optional[dict]
+) -> None:
+    _ring().add(("X", name, t0_ns, dur_ns, args))
+    ctr = _stall_counter(name)
+    if ctr is not None:
+        ctr.inc(dur_ns / 1e9)
 
 
 def add_complete(
@@ -240,7 +294,7 @@ def add_complete(
     its ``_TimedSpan`` already holds the timestamps, so the seam costs
     one call + one append."""
     if enabled():
-        _ring().add(("X", name, t0_ns, dur_ns, args))
+        _record_complete(name, t0_ns, dur_ns, args)
 
 
 class _Span:
@@ -259,8 +313,8 @@ class _Span:
 
     def __exit__(self, *exc) -> bool:
         t0 = self._t0
-        _ring().add(
-            ("X", self._name, t0, time.perf_counter_ns() - t0, self._args)
+        _record_complete(
+            self._name, t0, time.perf_counter_ns() - t0, self._args
         )
         return False
 
@@ -305,7 +359,7 @@ def end(args: Optional[dict] = None) -> None:
         ring.dropped += 1
         return
     name, t0 = ring.stack.pop()
-    ring.add(("X", name, t0, time.perf_counter_ns() - t0, args))
+    _record_complete(name, t0, time.perf_counter_ns() - t0, args)
 
 
 def instant(name: str, **args) -> None:
@@ -321,6 +375,155 @@ def counter(name: str, value: float) -> None:
     as a stacked chart row in Perfetto."""
     if enabled():
         _ring().add(("C", name, time.perf_counter_ns(), value, None))
+
+
+# -- causal RPC trace context --------------------------------------------------
+#
+# A compact trace context — trace id + parent span id, 16 hex digits
+# each, encoded "<trace>-<span>" — rides every wire protocol in the
+# repo (tracker cmd strings, collective DCL1 frames, dsserve slot meta,
+# blockcache control frames, lookup requests) so a server-side handler
+# span can be causally bound to the client wait span that triggered it.
+# The binding renders as Chrome/Perfetto FLOW events: the client emits
+# a flow-start ("s") inside its wait span at request time
+# (``rpc_context``), the server a flow-finish ("f") inside its handler
+# span (``handler_flow``/``handler_span``) — Perfetto draws the arrow.
+#
+# Encoding and decoding live HERE and only here (lint L017, the
+# L006-L016 single-site pattern): every other module carries the
+# context as an opaque string (or, on the collective's binary frames,
+# the raw 64-bit flow id), so the format cannot fork per protocol.
+
+#: flow s/f events must agree on name+cat to bind; one constant name
+_FLOW_NAME = "rpc"
+
+_TRACE_ID: Optional[int] = None
+_CLOCK_OFFSET_NS: Optional[float] = None
+_CLOCK_OFFSET_SOURCE: Optional[str] = None
+
+
+def _job_trace_id() -> int:
+    """This process's trace id: ``DMLC_TRACE_ID`` (hex — dmlc-submit
+    exports one id for the whole job so every process's spans share a
+    trace), else a random per-process id."""
+    global _TRACE_ID
+    if _TRACE_ID is None:
+        raw = os.environ.get("DMLC_TRACE_ID", "").strip()
+        tid = 0
+        if raw:
+            try:
+                tid = int(raw, 16) & ((1 << 64) - 1)
+            except ValueError:
+                tid = 0
+        _TRACE_ID = tid or (random.getrandbits(63) | 1)
+    return _TRACE_ID
+
+
+def encode_context(trace_id: int, span_id: int) -> str:
+    """Wire form of a trace context (the ONLY place it is spelled)."""
+    return f"{trace_id & ((1 << 64) - 1):016x}-{span_id & ((1 << 64) - 1):016x}"
+
+
+def decode_context(ctx) -> Optional[Tuple[int, int]]:
+    """(trace_id, span_id) or None — never raises: contexts arrive
+    from the wire and a malformed one costs the arrow, not the
+    request."""
+    if not isinstance(ctx, str) or len(ctx) != 33 or ctx[16] != "-":
+        return None
+    try:
+        return int(ctx[:16], 16), int(ctx[17:], 16)
+    except ValueError:
+        return None
+
+
+def rpc_context() -> Optional[str]:
+    """Mint a context for an outgoing request and record its flow-start
+    on this thread's ring. Call INSIDE the client's wait span (the
+    flow arrow starts from the slice enclosing the event). None when
+    the recorder is off — callers simply omit the wire field."""
+    if not enabled():
+        return None
+    span_id = random.getrandbits(63) | 1
+    _ring().add(("s", _FLOW_NAME, time.perf_counter_ns(), span_id, None))
+    return encode_context(_job_trace_id(), span_id)
+
+
+def handler_flow(ctx) -> None:
+    """Record the flow-finish for a received context. Call INSIDE the
+    server-side handler span; a missing/malformed context is a no-op."""
+    if not enabled():
+        return
+    dec = decode_context(ctx)
+    if dec is not None:
+        _ring().add(("f", _FLOW_NAME, time.perf_counter_ns(), dec[1], None))
+
+
+class _HandlerSpan(_Span):
+    """A span that also lands the incoming flow arrow just after its
+    own start (the "f" event must be temporally enclosed by the
+    handler slice for Perfetto to bind it)."""
+
+    __slots__ = ("_ctx",)
+
+    def __init__(self, name: str, args: Optional[dict], ctx) -> None:
+        super().__init__(name, args)
+        self._ctx = ctx
+
+    def __enter__(self) -> "_HandlerSpan":
+        super().__enter__()
+        handler_flow(self._ctx)
+        return self
+
+
+def handler_span(
+    name: str, ctx=None, **args
+) -> Union[_HandlerSpan, _NullSpan]:
+    """Server-side handler span carrying the client's trace context:
+    records one complete span AND (when ``ctx`` decodes) the
+    flow-finish binding it to the client's wait span. The context is
+    kept in the span args (``tc``) for grep-ability on a raw trace."""
+    if not enabled():
+        return _NULL
+    if ctx:
+        args["tc"] = ctx
+    return _HandlerSpan(name, args or None, ctx)
+
+
+def flow_send_id() -> int:
+    """Binary-frame variant of :func:`rpc_context` (the collective's
+    DCL1 header carries a raw u64, not a string): records the
+    flow-start, returns the id — 0 when the recorder is off (receivers
+    skip 0)."""
+    if not enabled():
+        return 0
+    span_id = random.getrandbits(63) | 1
+    _ring().add(("s", _FLOW_NAME, time.perf_counter_ns(), span_id, None))
+    return span_id
+
+
+def flow_recv(flow_id: int) -> None:
+    """Binary-frame variant of :func:`handler_flow`."""
+    if flow_id and enabled():
+        _ring().add(
+            ("f", _FLOW_NAME, time.perf_counter_ns(), int(flow_id), None)
+        )
+
+
+def set_clock_offset(offset_ns: float, source: str = "heartbeat_rtt") -> None:
+    """Record this process's estimated wall-clock offset against the
+    job's reference clock (the tracker): ``local_wall - tracker_wall``
+    in ns, estimated from a request/reply RTT midpoint
+    (client.py heartbeat). Exported in the trace's ``otherData`` so a
+    multi-HOST merge can align timelines (``merge_traces(...,
+    align_clocks=True)`` / ``tools trace merge --align-clocks``);
+    same-host processes already agree through the shared wall clock."""
+    global _CLOCK_OFFSET_NS, _CLOCK_OFFSET_SOURCE
+    _CLOCK_OFFSET_NS = float(offset_ns)
+    _CLOCK_OFFSET_SOURCE = str(source)
+
+
+def clock_offset_ns() -> Optional[float]:
+    return _CLOCK_OFFSET_NS
 
 
 def stats() -> Dict[str, Any]:
@@ -349,12 +552,16 @@ def reset() -> None:
     too, so a long-lived pool thread cannot keep writing into a ring
     the registry no longer exports."""
     global _ENABLED_ENV, _DROPPED_RINGS, _RESET_GEN
+    global _TRACE_ID, _CLOCK_OFFSET_NS, _CLOCK_OFFSET_SOURCE
     with _RINGS_LOCK:
         _RINGS.clear()
         _DROPPED_RINGS = 0
         _RESET_GEN += 1
     _TLS.__dict__.pop("ring", None)
     _ENABLED_ENV = None
+    _TRACE_ID = None  # re-read DMLC_TRACE_ID (test isolation)
+    _CLOCK_OFFSET_NS = None
+    _CLOCK_OFFSET_SOURCE = None
 
 
 # -- Chrome trace-event export -------------------------------------------------
@@ -405,6 +612,15 @@ def to_chrome_trace(extra_meta: Optional[dict] = None) -> dict:
                 ev["s"] = "t"  # thread-scoped instant
                 if args:
                     ev["args"] = args
+            elif ph in ("s", "f"):
+                # flow start/finish: id+cat+name must agree for
+                # Perfetto to draw the arrow; bp="e" binds the finish
+                # to its ENCLOSING slice (the handler span), not the
+                # next slice to start
+                ev["cat"] = "dmlc.flow"
+                ev["id"] = f"{extra:x}"
+                if ph == "f":
+                    ev["bp"] = "e"
             else:  # "C"
                 ev["args"] = {"value": extra}
             events.append(ev)
@@ -416,6 +632,11 @@ def to_chrome_trace(extra_meta: Optional[dict] = None) -> dict:
         "dropped_events": dropped,
         "dropped_rings": _DROPPED_RINGS,
     }
+    if _CLOCK_OFFSET_NS is not None:
+        # local_wall - reference_wall (see set_clock_offset): a
+        # multi-host merge subtracts this from every ts to align
+        other["clock_offset_ns"] = _CLOCK_OFFSET_NS
+        other["clock_offset_source"] = _CLOCK_OFFSET_SOURCE
     if extra_meta:
         other.update(extra_meta)
     return {
@@ -543,7 +764,9 @@ def _dump_at_exit() -> None:
 # -- cross-process merge -------------------------------------------------------
 
 
-def merge_traces(inputs: Iterable[Union[str, dict]]) -> dict:
+def merge_traces(
+    inputs: Iterable[Union[str, dict]], align_clocks: bool = False
+) -> dict:
     """Join per-process traces into ONE timeline keyed by rank/pid.
 
     Inputs are paths or already-loaded trace dicts. Events keep their
@@ -551,7 +774,12 @@ def merge_traces(inputs: Iterable[Union[str, dict]]) -> dict:
     colliding pids across files (containers, recycled pids) are
     remapped to unique synthetic pids so Perfetto never folds two
     processes into one row group. Per-file ``otherData`` — labels,
-    ranks, drop counts — is kept under ``otherData.processes``."""
+    ranks, drop counts, the heartbeat-estimated ``clock_offset_ns`` —
+    is kept under ``otherData.processes``. ``align_clocks`` subtracts
+    each file's recorded clock offset from its timestamps, mapping
+    every process onto the tracker's clock (multi-HOST merges; the
+    default keeps raw timestamps because on one host the RTT estimate
+    is pure noise against an already-shared wall clock)."""
     events: List[dict] = []
     processes: List[dict] = []
     seen_pids: Dict[int, int] = {}  # original pid -> assigned pid
@@ -561,6 +789,11 @@ def merge_traces(inputs: Iterable[Union[str, dict]]) -> dict:
         other = dict(trace.get("otherData") or {})
         other.setdefault("source", item if isinstance(item, str) else i)
         processes.append(other)
+        shift_us = 0.0
+        if align_clocks:
+            off = other.get("clock_offset_ns")
+            if isinstance(off, (int, float)):
+                shift_us = float(off) / 1000.0
         remap: Dict[int, int] = {}
         for ev in trace.get("traceEvents", ()):
             pid = ev.get("pid", 0)
@@ -573,6 +806,8 @@ def merge_traces(inputs: Iterable[Union[str, dict]]) -> dict:
                     remap[pid] = pid
             ev = dict(ev)
             ev["pid"] = remap[pid]
+            if shift_us and "ts" in ev:
+                ev["ts"] = ev["ts"] - shift_us
             events.append(ev)
     # stable timeline order (metadata events carry no ts; keep first)
     events.sort(key=lambda e: e.get("ts", float("-inf")))

@@ -521,7 +521,9 @@ def _cmd_trace(args) -> int:
             print("error: trace merge needs -o OUT and >=1 input",
                   file=sys.stderr)
             return 2
-        merged = tracing.merge_traces(args.inputs)
+        merged = tracing.merge_traces(
+            args.inputs, align_clocks=args.align_clocks
+        )
         tracing.write_trace(merged, args.out)
         print(
             f"merged {merged['otherData']['merged']} trace(s), "
@@ -632,6 +634,179 @@ def _cmd_ckpt(args) -> int:
     removed = ck.prune(keep=args.keep)
     print(json.dumps({"kept": ck.steps(), "removed": removed}))
     return 0
+
+
+def _top_endpoint(raw: str) -> str:
+    """Normalize the endpoint argument: full URL, host:port, or a bare
+    port (loopback — the tracker binds 127.0.0.1)."""
+    raw = (raw or "").strip()
+    if not raw:
+        raw = os.environ.get("DMLC_METRICS_PORT", "")
+    if not raw:
+        raise Error(
+            "tools top needs the tracker metrics endpoint (a URL, "
+            "host:port or port — the tracker logs 'telemetry endpoint "
+            "on 127.0.0.1:PORT/metrics' at start, or pin it with "
+            "DMLC_METRICS_PORT)"
+        )
+    if raw.isdigit():
+        raw = f"127.0.0.1:{raw}"
+    if not raw.startswith(("http://", "https://")):
+        raw = f"http://{raw}"
+    return raw.rstrip("/")
+
+
+def _top_model(report: dict, window: float) -> dict:
+    """Flatten a ``/metrics.json?window=`` report into the dashboard's
+    model (also the ``--once --json`` output): per-rank and cluster
+    rows/s, stall fractions, queue depth, cache hit rates, service
+    QPS/p99. Pure — unit-testable without a tracker."""
+    win = report.get("windowed") or {}
+    per_rank = win.get("per_rank") or {}
+    cluster = win.get("cluster") or {}
+    def rank_order(kv):
+        # tracker row first, then ranks NUMERICALLY (string sort puts
+        # rank 10 before rank 2 on a 12-worker job)
+        rank = kv[0]
+        if rank == "tracker":
+            return (0, 0, rank)
+        try:
+            return (1, int(rank), rank)
+        except ValueError:
+            return (2, 0, rank)
+
+    ranks = {}
+    for rank, view in sorted(per_rank.items(), key=rank_order):
+        d = view.get("derived") or {}
+        ranks[rank] = {
+            "rows_per_sec": d.get("rows_per_sec", 0.0),
+            "stall_fraction": d.get("stall_fraction", {}),
+            "samples": view.get("samples", 0),
+            **{
+                k: d[k]
+                for k in (
+                    "block_cache_hit_rate",
+                    "decode_cache_hit_rate",
+                    "lookup_qps",
+                    "lookup_p99_ms",
+                    "dsserve_slots_per_sec",
+                    "shard_queue_depth",
+                )
+                if k in d
+            },
+        }
+    cd = cluster.get("derived") or {}
+    # the shard queue depth lives on the tracker pseudo-rank's gauges
+    qd = (
+        (per_rank.get("tracker") or {})
+        .get("gauges", {})
+        .get("tracker.shards.queue_depth")
+    )
+    model = {
+        "window_secs": window,
+        "n_ranks": cluster.get("n_ranks", 0),
+        "ranks": ranks,
+        "cluster": cd,
+    }
+    if qd is not None:
+        model["shard_queue_depth"] = qd
+    return model
+
+
+def _bar(frac: float, width: int = 10) -> str:
+    frac = max(0.0, min(1.0, float(frac)))
+    n = int(round(frac * width))
+    return "█" * n + "·" * (width - n)
+
+
+def _fmt_rate(v: float) -> str:
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M"
+    if v >= 1e3:
+        return f"{v / 1e3:.1f}k"
+    return f"{v:.1f}"
+
+
+def _render_top(model: dict, endpoint: str) -> str:
+    lines = [
+        f"dmlc top — {endpoint}  window={model['window_secs']:g}s  "
+        f"ranks={model['n_ranks']}"
+    ]
+    cd = model.get("cluster") or {}
+    summary = [f"cluster rows/s {_fmt_rate(cd.get('rows_per_sec', 0.0))}"]
+    if "shard_queue_depth" in model:
+        summary.append(
+            f"shard queue {model['shard_queue_depth'].get('last', 0):g}"
+        )
+    for key, label in (
+        ("block_cache_hit_rate", "blockcache hit"),
+        ("decode_cache_hit_rate", "decode hit"),
+    ):
+        if key in cd:
+            summary.append(f"{label} {cd[key] * 100:.0f}%")
+    if "lookup_qps" in cd:
+        p99 = cd.get("lookup_p99_ms")
+        summary.append(
+            f"lookup {cd['lookup_qps']:g} qps"
+            + (f" p99 {p99:g}ms" if p99 is not None else "")
+        )
+    if "dsserve_slots_per_sec" in cd:
+        summary.append(f"dsserve {cd['dsserve_slots_per_sec']:g} slots/s")
+    lines.append("  ".join(summary))
+    lines.append("")
+    lines.append(f"{'rank':>8}  {'rows/s':>10}  stall by stage")
+    for rank, r in (model.get("ranks") or {}).items():
+        stalls = sorted(
+            (r.get("stall_fraction") or {}).items(),
+            key=lambda kv: -kv[1],
+        )[:3]
+        stall_txt = "  ".join(
+            f"{stage} {_bar(frac)} {frac * 100:.0f}%"
+            for stage, frac in stalls
+            if frac > 0
+        )
+        lines.append(
+            f"{rank:>8}  {_fmt_rate(r.get('rows_per_sec', 0.0)):>10}  "
+            f"{stall_txt}"
+        )
+    return "\n".join(lines)
+
+
+def _cmd_top(args) -> int:
+    """Live terminal dashboard over the tracker's windowed telemetry
+    (docs/observability.md "Time series"): polls
+    ``/metrics.json?window=N`` and renders per-rank rows/s, the top
+    stall stages as bars, shard queue depth, cache hit rates and
+    service QPS/p99. ``--once`` renders one frame (``--json`` for the
+    machine-readable model) — the scripted/tier-1 mode."""
+    import json as _json
+    import time as _time
+
+    from ..io import retry as _retry
+
+    endpoint = _top_endpoint(args.endpoint)
+    url = f"{endpoint}/metrics.json?window={args.window:g}"
+
+    def fetch() -> dict:
+        with _retry.request(url, timeout=10.0) as resp:
+            return _json.loads(resp.read().decode())
+
+    if args.once:
+        model = _top_model(fetch(), args.window)
+        if args.json:
+            print(_json.dumps(model, indent=1))
+        else:
+            print(_render_top(model, endpoint))
+        return 0
+    try:
+        while True:
+            frame = _render_top(_top_model(fetch(), args.window), endpoint)
+            # clear + home, then the frame (plain ANSI — no curses dep)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -839,7 +1014,41 @@ def build_arg_parser() -> argparse.ArgumentParser:
         "--json", action="store_true",
         help="report: emit the full report as JSON",
     )
+    tr.add_argument(
+        "--align-clocks", action="store_true",
+        help="merge: shift each file's timestamps by its recorded "
+             "heartbeat-RTT clock offset (multi-HOST runs; same-host "
+             "files already share a wall clock)",
+    )
     tr.set_defaults(fn=_cmd_trace)
+
+    top = sub.add_parser(
+        "top",
+        help="live cluster dashboard over the tracker's "
+             "/metrics.json?window= endpoint",
+    )
+    top.add_argument(
+        "endpoint", nargs="?", default="",
+        help="tracker metrics endpoint: URL, host:port or bare port "
+             "(the tracker logs 'telemetry endpoint on ...' at start)",
+    )
+    top.add_argument(
+        "--window", default=30.0, type=float,
+        help="rate window in seconds (default 30)",
+    )
+    top.add_argument(
+        "--interval", default=2.0, type=float,
+        help="refresh interval in seconds (default 2)",
+    )
+    top.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (scripts/tests)",
+    )
+    top.add_argument(
+        "--json", action="store_true",
+        help="with --once: print the derived model as JSON",
+    )
+    top.set_defaults(fn=_cmd_top)
 
     ck = sub.add_parser(
         "ckpt", help="inspect/prune checkpoint directories (any URI)"

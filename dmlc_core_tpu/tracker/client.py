@@ -20,6 +20,8 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..io.retry import _env_float
+from ..telemetry import timeseries as _timeseries
+from ..telemetry import tracing as _tracing
 from .protocol import (
     CMD_METRICS,
     CMD_PRINT,
@@ -72,11 +74,13 @@ class RabitWorker:
         self._listener: Optional[socket.socket] = None
         self.connect_timeout = _env_float("DMLC_PEER_CONNECT_TIMEOUT", 30.0)
         self._shut = False
+        self._ts_seq = 0  # newest time-series sample seq already shipped
 
     # -- tracker connection helpers -----------------------------------------
     def _connect_tracker(self, cmd: str, rank: int, world: int) -> FramedSocket:
         return connect_worker(
-            self.tracker_uri, self.tracker_port, rank, world, self.jobid, cmd
+            self.tracker_uri, self.tracker_port, rank, world, self.jobid, cmd,
+            trace_ctx=_tracing.rpc_context(),
         )
 
     # -- rendezvous ----------------------------------------------------------
@@ -106,6 +110,12 @@ class RabitWorker:
         # BY rendezvous rank, so a lease client in this process must
         # lease under the same number (tracker/shardsvc.py)
         os.environ["DMLC_SHARD_RANK"] = str(self.rank)
+        # every rendezvoused worker samples its registry on the default
+        # time-series ring (DMLC_TS_INTERVAL, default 2 s; DMLC_TS=off
+        # disables) — heartbeats ship the new samples so the tracker's
+        # /metrics.json?window= has per-rank windowed rates
+        if _timeseries.sampling_enabled():
+            _timeseries.ensure_default()
         self.parent = fs.recv_int()
         self.world_size = fs.recv_int()
         n_tree = fs.recv_int()
@@ -220,6 +230,14 @@ class RabitWorker:
         (docs/observability.md). Call it from the training loop at
         whatever cadence suits the job (each epoch is plenty).
 
+        When the default time-series ring is sampling (every
+        rendezvoused worker's is), the payload also carries the ring's
+        NEW samples under ``timeseries`` — the increments feeding the
+        tracker's windowed-rate store — and the tracker's wall-stamp
+        reply is bracketed to estimate this host's clock offset
+        (RTT midpoint → ``tracing.set_clock_offset``; a multi-host
+        trace merge aligns timelines with it).
+
         Requires a completed ``start()``: without a rank the tracker
         would silently drop the frame — fail loudly at the caller
         instead."""
@@ -228,13 +246,67 @@ class RabitWorker:
                 "heartbeat() before start(): this worker has no rank yet, "
                 "so the tracker would discard its metrics"
             )
+        ring = None
         if metrics is None:
             from ..telemetry import default_registry
 
             metrics = default_registry().snapshot()
-        fs = self._connect_tracker(CMD_METRICS, self.rank, -1)
-        fs.send_str(json.dumps(metrics, separators=(",", ":")))
-        fs.close()
+            # the default-snapshot heartbeat also ships the ring's new
+            # samples; an explicit payload stays exactly what the
+            # caller handed over
+            ring = _timeseries.default_ring(create=False)
+        shipped_seq = None
+        if ring is not None:
+            ring.sample()  # the series always reaches "now"
+            new = ring.samples(since=self._ts_seq)
+            if new:
+                metrics = dict(metrics)
+                metrics["timeseries"] = new
+                shipped_seq = new[-1]["seq"]
+        data = json.dumps(metrics, separators=(",", ":"))
+        # the rendezvous string framing bounds payloads at MAX_STR
+        # (1 MiB): a fat registry × many retained samples must shed its
+        # OLDEST samples (already aged out of any live window) rather
+        # than have the tracker call the frame hostile and drop it
+        budget = FramedSocket.MAX_STR - (128 << 10)
+        while len(data) > budget and len(metrics.get("timeseries", ())) > 1:
+            keep = metrics["timeseries"]
+            metrics["timeseries"] = keep[(len(keep) + 1) // 2 :]
+            data = json.dumps(metrics, separators=(",", ":"))
+        if len(data) > budget and "timeseries" in metrics:
+            # even one sample blows the frame (a gigantic registry):
+            # ship the bare snapshot and DON'T advance the shipped seq
+            # — un-shipped samples stay eligible for the next attempt
+            metrics = {k: v for k, v in metrics.items() if k != "timeseries"}
+            data = json.dumps(metrics, separators=(",", ":"))
+            shipped_seq = None
+        with _tracing.span("dmlc:heartbeat", rank=self.rank):
+            fs = self._connect_tracker(CMD_METRICS, self.rank, -1)
+            try:
+                fs.send_str(data)
+                # the tracker answers with its wall stamp the moment it
+                # has read the payload; offset = RTT midpoint - stamp.
+                # t0 is taken AFTER the upload so the bracket spans
+                # only the tracker's read-tail + reply — bracketing the
+                # connect+upload would bias the midpoint by half the
+                # payload's transfer time
+                t0 = time.time_ns()  # noqa: L008 (RTT bracketing wall stamps for clock-offset estimation, not a duration)
+                try:
+                    reply = json.loads(fs.recv_str())
+                    t1 = time.time_ns()  # noqa: L008 (RTT bracketing wall stamp, see above)
+                    wall = reply.get("wall_ns")
+                    if isinstance(wall, (int, float)):
+                        _tracing.set_clock_offset(
+                            (t0 + t1) / 2.0 - float(wall)
+                        )
+                except (ConnectionError, OSError, ValueError):
+                    pass  # an old tracker replies nothing: no estimate
+            finally:
+                fs.close()
+        if shipped_seq is not None:
+            # advance only after the send went through — a failed
+            # heartbeat re-ships its samples next time
+            self._ts_seq = shipped_seq
 
     def shutdown(self) -> None:
         """Signal completion (cmd=shutdown, reference tracker.py:272-277).

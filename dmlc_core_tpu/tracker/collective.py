@@ -141,9 +141,13 @@ _LINK_WAIT = _registry.histogram(
 # One fixed header per message; payloads are raw ndarray bytes (dtype
 # and shape are call-site contract — every rank passes the same). The
 # seq field tags the round; aux carries the ring step / reset attempt /
-# checkpoint version.
+# checkpoint version; flow is the sender's flight-recorder flow id
+# (0 = recorder off) binding the receiver's allreduce_wait span to the
+# remote send that unblocked it on a merged timeline — the trace
+# context's binary form (telemetry/tracing.py flow_send_id/flow_recv;
+# every rank runs the same build, so widening the header is safe).
 _FRAME_MAGIC = 0x44434C31  # "DCL1"
-_HDR = struct.Struct("<IBIIq")  # magic u32, kind u8, seq u32, aux u32, nbytes i64
+_HDR = struct.Struct("<IBIIqQ")  # magic u32, kind u8, seq u32, aux u32, nbytes i64, flow u64
 _MAX_PAYLOAD = 1 << 31
 
 K_DATA = 1  # child -> parent reduce contribution (tree)
@@ -873,7 +877,10 @@ class Collective:
         sock = self._prepared(rank)
         try:
             sock.sendall(
-                _HDR.pack(_FRAME_MAGIC, kind, seq, aux, len(payload))
+                _HDR.pack(
+                    _FRAME_MAGIC, kind, seq, aux, len(payload),
+                    _tracing.flow_send_id(),
+                )
             )
             if payload:
                 sock.sendall(payload)
@@ -905,12 +912,16 @@ class Collective:
     ) -> Tuple[int, int, int, bytes]:
         sock.settimeout(self.io_timeout)
         hdr = self._recv_exact(rank, sock, _HDR.size)
-        magic, kind, seq, aux, nbytes = _HDR.unpack(hdr)
+        magic, kind, seq, aux, nbytes, flow = _HDR.unpack(hdr)
         if magic != _FRAME_MAGIC or not 0 <= nbytes <= _MAX_PAYLOAD:
             raise _LinkDied(
                 rank, ConnectionError(f"bad frame (magic={magic:#x})")
             )
         payload = self._recv_exact(rank, sock, nbytes) if nbytes else b""
+        # land the sender's flow arrow inside whatever wait span this
+        # recv runs under (allreduce_wait): cause -> effect on the
+        # merged timeline
+        _tracing.flow_recv(flow)
         return kind, seq, aux, payload
 
     def _pump(

@@ -84,7 +84,32 @@ __all__ = [
     "make_listener",
     "bind_first_free",
     "find_free_port",
+    "pack_cmd",
+    "unpack_cmd",
 ]
+
+#: separator between a cmd and its piggybacked trace context on the
+#: handshake's cmd string (ASCII unit separator: can never appear in a
+#: command name). The context itself is OPAQUE here — encoding and
+#: decoding belong to telemetry/tracing.py (lint L017); this module
+#: only carries the string, so every worker→tracker command (rendezvous
+#: AND shard AND metrics) propagates causality over one mechanism.
+_CTX_SEP = "\x1f"
+
+
+def pack_cmd(cmd: str, trace_ctx=None) -> str:
+    """Attach an opaque trace context to a cmd string (None = bare
+    cmd — the reference-compatible form)."""
+    if not trace_ctx:
+        return cmd
+    return f"{cmd}{_CTX_SEP}{trace_ctx}"
+
+
+def unpack_cmd(raw: str):
+    """(cmd, trace_ctx-or-None) from a received cmd string. A bare
+    reference-client cmd passes through unchanged."""
+    cmd, sep, ctx = raw.partition(_CTX_SEP)
+    return cmd, (ctx if sep else None)
 
 
 class FramedSocket:
@@ -212,12 +237,16 @@ def connect_worker(
     jobid: str,
     cmd: str,
     timeout: float = 30.0,
+    trace_ctx=None,
 ) -> FramedSocket:
     """Dial the tracker and complete the client-side preamble every
     worker connection shares — magic exchange, then rank / world_size /
     jobid / cmd (the frame order WorkerEntry reads). THE one handshake
     site: RabitWorker and ShardLeaseClient both ride it, so a protocol
-    preamble change cannot drift between them."""
+    preamble change cannot drift between them. ``trace_ctx`` (an
+    opaque string from ``telemetry.tracing.rpc_context()``) piggybacks
+    on the cmd string so the tracker's handler span can be causally
+    bound to the caller's wait span."""
     sock = socket.create_connection((host, port), timeout=timeout)
     try:
         fs = FramedSocket(sock)
@@ -228,7 +257,7 @@ def connect_worker(
         fs.send_int(rank)
         fs.send_int(world_size)
         fs.send_str(str(jobid))
-        fs.send_str(cmd)
+        fs.send_str(pack_cmd(cmd, trace_ctx))
         return fs
     except BaseException:
         sock.close()

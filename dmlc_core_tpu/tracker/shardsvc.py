@@ -53,6 +53,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional
 
 from ..telemetry import default_registry as _default_registry
+from ..telemetry import tracing as _tracing
 from .protocol import (
     CMD_SHARD_DONE,
     CMD_SHARD_LEASE,
@@ -705,9 +706,13 @@ class ShardLeaseClient:
             return 0
 
     def _call(self, cmd: str, payload: Dict) -> Dict:
+        # the piggybacked trace context binds the tracker's handler
+        # span to whatever wait span encloses this call (the
+        # shard_lease_wait stall gets its causal arrow on a merged
+        # timeline, docs/observability.md)
         fs = connect_worker(
             self.tracker_uri, self.tracker_port, self.rank, -1, "NULL",
-            cmd, self.timeout,
+            cmd, self.timeout, trace_ctx=_tracing.rpc_context(),
         )
         try:
             fs.send_str(json.dumps(payload, separators=(",", ":")))

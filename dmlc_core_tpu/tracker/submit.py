@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import sys
 from typing import List, Optional
 
@@ -49,6 +50,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         # rings into the directory at exit (telemetry/tracing.py)
         os.makedirs(args.trace_dir, exist_ok=True)
         os.environ["DMLC_TRACE_DIR"] = args.trace_dir
+    # one trace id for the whole job: every launched process inherits
+    # it, so spans from the tracker, workers and daemons share a trace
+    # (telemetry/tracing.py trace contexts; hex — the opaque encoding
+    # belongs to tracing, this is just a seed)
+    os.environ.setdefault(
+        "DMLC_TRACE_ID", f"{random.getrandbits(63) | 1:x}"
+    )
     get_backend(args.cluster)(args)
 
 

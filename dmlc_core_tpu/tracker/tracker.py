@@ -11,6 +11,7 @@ contract rabit builds on (SURVEY §5.3).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import queue
@@ -21,6 +22,7 @@ import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..telemetry import ClusterAggregator, serve_metrics
+from ..telemetry import timeseries as _timeseries
 from ..telemetry import tracing as _tracing
 from . import collective as _collective
 from . import shardsvc as _shardsvc
@@ -32,10 +34,12 @@ from .protocol import (
     CMD_START,
     CMD_WATCH,
     MAGIC,
+    RENDEZVOUS_CMDS,
     SHARD_CMDS,
     FramedSocket,
     bind_first_free,
     find_free_port,
+    unpack_cmd,
 )
 from .supervisor import RendezvousNeverCompleted
 from .topology import get_link_map
@@ -95,7 +99,10 @@ class WorkerEntry:
         self.rank = self.sock.recv_int()
         self.world_size = self.sock.recv_int()
         self.jobid = self.sock.recv_str()
-        self.cmd = self.sock.recv_str()
+        # the cmd string may carry a piggybacked trace context
+        # (protocol.pack_cmd) binding this connection's handler span to
+        # the caller's wait span on a merged timeline
+        self.cmd, self.trace_ctx = unpack_cmd(self.sock.recv_str())
         self.wait_accept = 0
         self.port: Optional[int] = None
         #: filled for cmd == 'print' (log line) / cmd == 'metrics'
@@ -335,6 +342,15 @@ class RabitTracker:
         self.metrics_report: Optional[Dict[str, object]] = None
         self.metrics_port: Optional[int] = None
         self._metrics_server = None
+        # the tracker samples its OWN registry into the cluster store
+        # under the "tracker" pseudo-rank — that is how the shard
+        # queue-depth gauge (tracker.shards.queue_depth) gets a history
+        # behind /metrics.json?window= (docs/sharding.md)
+        self._ts_ring = _timeseries.TimeSeriesRing(
+            on_sample=lambda s: self.metrics.timeseries.add(
+                _timeseries.TRACKER_RANK, [s]
+            )
+        )
         # dynamic shard service (shardsvc.py, docs/sharding.md): a
         # leased micro-shard work queue riding this tracker's socket —
         # idle until the first cmd=shard_lease arrives, so static jobs
@@ -377,40 +393,67 @@ class RabitTracker:
 
     def _handshake(self, conn: socket.socket, addr: Tuple) -> None:
         """Blocking WorkerEntry construction off the state thread: a
-        slow-loris client burns only this thread's timeout."""
+        slow-loris client burns only this thread's timeout. The
+        server-side work done HERE (payload read, shard-ledger call,
+        reply) runs under a handler span carrying the client's trace
+        context, so a merged timeline draws the flow arrow from the
+        worker's wait span to this handling (docs/observability.md)."""
         try:
             entry = WorkerEntry(conn, addr)
-            if entry.cmd in (CMD_PRINT, CMD_METRICS) or entry.cmd in SHARD_CMDS:
-                # read the one-string payload here too — it is the other
-                # blocking recv a hostile client could stall on
-                entry.print_msg = entry.sock.recv_str()
-            if entry.cmd in SHARD_CMDS:
-                # shard lease traffic is answered HERE, off the state
-                # thread: the ledger has its own lock, the state machine
-                # never blocks on a lease client, and lease latency does
-                # not ride the event queue. One request frame in, one
-                # JSON response frame out, connection closed.
-                resp = self.shards.handle(
-                    entry.cmd, entry.rank, entry.print_msg or ""
-                )
-                entry.sock.send_str(resp)
-                entry.sock.close()
-                return
-            if entry.cmd == CMD_WATCH:
-                # collective death watch: the connection STAYS OPEN and
-                # is push-only from here on (DeathWatch sends one JSON
-                # string frame per supervisor-reported task failure), so
-                # it never touches the state thread. A fabricated rank
-                # is dropped — it could otherwise evict a live watcher.
-                if not 0 <= entry.rank < self.n_workers:
-                    logger.warning(
-                        "watch registration from invalid rank %d — "
-                        "dropping connection", entry.rank,
+            # bounded span vocabulary: a hostile cmd string must not
+            # mint unbounded span names on the ring
+            kind = entry.cmd if entry.cmd in RENDEZVOUS_CMDS else "unknown"
+            with _tracing.handler_span(
+                f"dmlc:tracker_{kind}", entry.trace_ctx, rank=entry.rank
+            ):
+                if (
+                    entry.cmd in (CMD_PRINT, CMD_METRICS)
+                    or entry.cmd in SHARD_CMDS
+                ):
+                    # read the one-string payload here too — it is the
+                    # other blocking recv a hostile client could stall on
+                    entry.print_msg = entry.sock.recv_str()
+                if entry.cmd == CMD_METRICS:
+                    # answer with the tracker's wall stamp: the worker
+                    # brackets the exchange and estimates its clock
+                    # offset from the RTT midpoint (client.py heartbeat
+                    # → tracing.set_clock_offset); a worker that never
+                    # reads the reply is unaffected
+                    try:
+                        entry.sock.send_str(
+                            json.dumps({"wall_ns": time.time_ns()})  # noqa: L008 (wall stamp for cross-host clock alignment, not a duration)
+                        )
+                    except OSError:
+                        pass
+                if entry.cmd in SHARD_CMDS:
+                    # shard lease traffic is answered HERE, off the
+                    # state thread: the ledger has its own lock, the
+                    # state machine never blocks on a lease client, and
+                    # lease latency does not ride the event queue. One
+                    # request frame in, one JSON response frame out,
+                    # connection closed.
+                    resp = self.shards.handle(
+                        entry.cmd, entry.rank, entry.print_msg or ""
                     )
+                    entry.sock.send_str(resp)
                     entry.sock.close()
                     return
-                self.watch.add(entry.rank, entry.sock)
-                return
+                if entry.cmd == CMD_WATCH:
+                    # collective death watch: the connection STAYS OPEN
+                    # and is push-only from here on (DeathWatch sends
+                    # one JSON string frame per supervisor-reported
+                    # task failure), so it never touches the state
+                    # thread. A fabricated rank is dropped — it could
+                    # otherwise evict a live watcher.
+                    if not 0 <= entry.rank < self.n_workers:
+                        logger.warning(
+                            "watch registration from invalid rank %d — "
+                            "dropping connection", entry.rank,
+                        )
+                        entry.sock.close()
+                        return
+                    self.watch.add(entry.rank, entry.sock)
+                    return
         except (ConnectionError, OSError) as e:
             logger.warning("bad handshake: %s", e)
             conn.close()
@@ -751,6 +794,8 @@ class RabitTracker:
                 )
             except (OSError, ValueError) as e:
                 logger.warning("telemetry endpoint disabled: %s", e)
+        if _timeseries.sampling_enabled():
+            self._ts_ring.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rabit-accept",
         )
@@ -775,6 +820,7 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        self._ts_ring.stop()
         if self._metrics_server is not None:
             self._metrics_server.shutdown()
             # shutdown() only stops the serve loop; the bound listen

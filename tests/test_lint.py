@@ -596,3 +596,79 @@ def test_repo_lints_clean():
         timeout=120,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def _telemetry_findings(src, tmp_path, name="mod.py"):
+    """Findings for a file under dmlc_core_tpu/telemetry/ (inside the
+    L017 scope but away from L013/L014/L015's tracker-specific rules,
+    so assertions isolate the trace-context codec rule)."""
+    d = tmp_path / "dmlc_core_tpu" / "telemetry"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / name
+    f.write_text(src)
+    return [(code, line) for (_, line, code, _) in lint.lint_file(f)]
+
+
+def test_trace_context_codec_flagged_in_wire_trees(tmp_path):
+    """L017: the trace-context wire format (16-hex-digit ids, base-16
+    parsing) is encoded/decoded only in telemetry/tracing.py — a
+    hand-rolled copy elsewhere can drift the format and silently break
+    every flow arrow."""
+    hexfmt = "016" + "x"
+    # f-string encode
+    assert [c for c, _ in _telemetry_findings(
+        f'ctx = f"{{tid:{hexfmt}}}-{{sid:{hexfmt}}}"\n', tmp_path)
+    ] == ["L017", "L017"]
+    # %-format and str.format literals carry the same marker
+    assert [c for c, _ in _telemetry_findings(
+        f'ctx = "%{hexfmt}" % tid\n', tmp_path)] == ["L017"]
+    assert [c for c, _ in _telemetry_findings(
+        f'ctx = format(tid, "{hexfmt}")\n', tmp_path)] == ["L017"]
+    # base-16 decode, positionally or by keyword
+    assert [c for c, _ in _telemetry_findings(
+        'tid = int(ctx[:16], 16)\n', tmp_path)] == ["L017"]
+    assert [c for c, _ in _telemetry_findings(
+        'tid = int(ctx, base=16)\n', tmp_path)] == ["L017"]
+    # the rule covers every wire-speaking tree (tracker/ shown here)
+    assert "L017" in [c for c, _ in _tracker_findings(
+        'tid = int(ctx, 16)\n', tmp_path)]
+    # per-line opt-out works like every other rule
+    assert _telemetry_findings(
+        'tid = int(ctx, 16)  # noqa: L017 (fixture)\n', tmp_path) == []
+
+
+def test_trace_context_codec_quiet_in_owner_and_outside_scope(tmp_path):
+    # the flight recorder owns the codec
+    d = tmp_path / "dmlc_core_tpu" / "telemetry"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "tracing.py"
+    f.write_text('ctx = int("ff", 16)\n')
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # data/ parses hex for its own reasons (csv \x escapes) — out of
+    # scope; tests/benches too
+    dd = tmp_path / "dmlc_core_tpu" / "data"
+    dd.mkdir(parents=True, exist_ok=True)
+    f2 = dd / "mod.py"
+    f2.write_text('v = int(digits, 16)\n')
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f2)] == []
+    assert codes('x = int("ff", 16)\n', tmp_path) == []
+    # int() without a base-16 literal is not a decode
+    assert _telemetry_findings('n = int(x)\nm = int(y, 10)\n',
+                               tmp_path) == []
+
+
+def test_trace_context_codec_gate_matches_repo_state():
+    """The real tree passes L017 (the codec lives only in tracing.py):
+    run the shipped check over the repo's own wire trees."""
+    repo = lint.REPO
+    findings = []
+    for rel in ("dmlc_core_tpu/telemetry", "dmlc_core_tpu/tracker",
+                "dmlc_core_tpu/dsserve", "dmlc_core_tpu/io",
+                "dmlc_core_tpu/tools"):
+        for f in sorted((repo / rel).rglob("*.py")):
+            if "__pycache__" in f.parts:
+                continue
+            findings += [
+                x for x in lint.lint_file(f) if x[2] == "L017"
+            ]
+    assert findings == []

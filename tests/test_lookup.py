@@ -461,3 +461,62 @@ def test_lookup_telemetry_series_tick(tmp_path):
     assert reg.counter("io.lookup.negatives").value() == n0 + 1
     snap = reg.snapshot()["histograms"]
     assert "io.lookup.batch_seconds" in snap
+
+
+def test_lookup_wait_spans_have_flow_to_handler_spans(tmp_path):
+    """ISSUE 14 acceptance (lookup half): every ``lookup_wait`` span on
+    the client thread encloses a flow-start whose id matches a
+    flow-finish inside a server-side ``dmlc:lookup_*`` handler span —
+    Perfetto draws the causal arrow from the stall to the work."""
+    from dmlc_core_tpu.telemetry import tracing
+
+    tracing.reset()
+    tracing.set_enabled(True)
+    try:
+        path = _write_corpus(str(tmp_path / "flow.rec"), "zlib")
+        h = RecordLookup(path, decode_ctx=_l1_ctx())
+        srv = LookupServer(h, port=0)
+        try:
+            c = LookupClient("127.0.0.1", srv.port)
+            assert c.lookup([0]) == [_payload(0)]
+            c.warm(max_blocks=2)
+            c.stats()
+            c.close()
+        finally:
+            srv.close()
+            h.close()
+        evs = tracing.to_chrome_trace()["traceEvents"]
+        waits = [
+            e for e in evs
+            if e["ph"] == "X" and e["name"] == "dmlc:lookup_wait"
+        ]
+        assert waits, "no lookup_wait spans recorded"
+        handlers = [
+            e for e in evs
+            if e["ph"] == "X" and e["name"].startswith("dmlc:lookup_")
+            and e["name"] != "dmlc:lookup_wait"
+        ]
+        flows_s = {e["id"]: e for e in evs if e["ph"] == "s"}
+        flows_f = {e["id"]: e for e in evs if e["ph"] == "f"}
+        for w in waits:
+            enclosed = [
+                s for s in flows_s.values()
+                if s["pid"] == w["pid"] and s["tid"] == w["tid"]
+                and w["ts"] <= s["ts"] <= w["ts"] + w["dur"]
+            ]
+            assert enclosed, f"lookup_wait span at {w['ts']} has no flow"
+            sid = enclosed[0]["id"]
+            f = flows_f.get(sid)
+            assert f is not None, "flow never landed server-side"
+            host = next(
+                (hs for hs in handlers
+                 if hs["tid"] == f["tid"]
+                 and hs["ts"] <= f["ts"] <= hs["ts"] + hs["dur"]),
+                None,
+            )
+            assert host is not None, "flow-finish outside a handler span"
+            # the handler kept the wire context in its args
+            assert "tc" in host.get("args", {})
+    finally:
+        tracing.set_enabled(None)
+        tracing.reset()

@@ -772,3 +772,126 @@ def test_staging_stage_histograms_fed(tmp_path):
     ) == 64
     # io_stats() keeps its merged shape (source stats + staging block)
     assert "staging" in pipe.io_stats()
+
+
+# -- ISSUE 14 satellites -------------------------------------------------------
+
+
+def test_serve_metrics_http_concurrent_scrapes_and_idempotent_close():
+    """serve_metrics_http under 8 concurrent scrapers answers every
+    request with a parseable body, and BOTH halves of teardown are
+    idempotent — shutdown() + a double server_close() must be safe
+    (teardown paths race: atexit vs explicit close vs SIGTERM)."""
+    import urllib.request
+
+    from dmlc_core_tpu.telemetry import serve_metrics_http
+
+    reg = MetricRegistry()
+    reg.counter("io.split.records").inc(42)
+    server = serve_metrics_http(
+        0, registry=reg, json_provider=lambda: {"ok": True}
+    )
+    port = server.server_address[1]
+    results, errors = [], []
+
+    def scrape(path):
+        try:
+            for _ in range(5):
+                with urllib.request.urlopen(  # noqa: L006 (loopback test scrape, not remote IO)
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as resp:
+                    results.append(resp.read())
+        except Exception as e:  # collected, asserted below
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=scrape, args=(p,))
+        for p in ("/metrics", "/metrics.json", "/metrics", "/stats")
+        for _ in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(results) == 40
+    assert any(b"dmlc_io_split_records 42" in r for r in results)
+    server.shutdown()
+    server.server_close()
+    server.server_close()  # second close: no-op, no EBADF/double-free
+    server.shutdown()      # and shutdown after close stays safe
+
+
+def test_cluster_aggregator_skips_mismatched_histogram_edges():
+    """The satellite's aggregator coverage: two ranks whose histogram
+    EDGES disagree (version skew) — the merge keeps the first and
+    skips the rest; every other series still merges."""
+    agg = ClusterAggregator()
+    agg.update(0, {
+        "counters": {"c": 1}, "gauges": {},
+        "histograms": {"h": {"le": [1.0, 2.0], "n": [1, 2, 0],
+                             "count": 3, "sum": 3.0}},
+    })
+    agg.update(1, {
+        "counters": {"c": 2}, "gauges": {},
+        "histograms": {"h": {"le": [1.0, 4.0], "n": [5, 5, 0],
+                             "count": 10, "sum": 9.0}},
+    })
+    cluster = agg.cluster()
+    assert cluster["counters"]["c"] == 3  # counters still merged
+    # the mismatched histogram kept the FIRST rank's buckets only
+    assert cluster["histograms"]["h"]["count"] == 3
+    assert cluster["histograms"]["h"]["le"] == [1.0, 2.0]
+    # and the scrape keeps working end to end
+    assert "dmlc_h_bucket" in agg.prometheus()
+
+
+def test_cluster_aggregator_accepts_restart_timeseries():
+    """Heartbeat time-series samples from a rank that restarts mid-job:
+    the stale replayed tail is dropped (sample clock never goes
+    backwards), the fresh post-relaunch samples extend the SAME rank's
+    series, and windowed rates stay non-negative across the counter
+    reset."""
+    agg = ClusterAggregator()
+    snap = {"counters": {}, "gauges": {}, "histograms": {}}
+    agg.update(3, {**snap, "timeseries": [
+        {"t": 50.0, "seq": 1, "counters": {"io.split.records": 900.0},
+         "gauges": {}, "histograms": {}},
+        {"t": 52.0, "seq": 2, "counters": {"io.split.records": 1800.0},
+         "gauges": {}, "histograms": {}},
+    ]})
+    # relaunch: seq and counters restart; first sample replays t=51
+    agg.update(3, {**snap, "timeseries": [
+        {"t": 51.0, "seq": 1, "counters": {"io.split.records": 100.0},
+         "gauges": {}, "histograms": {}},
+        {"t": 55.0, "seq": 2, "counters": {"io.split.records": 400.0},
+         "gauges": {}, "histograms": {}},
+    ]})
+    assert agg.timeseries.dropped_stale == 1
+    view = agg.windowed(60.0)["per_rank"]["3"]
+    assert view["samples"] == 3
+    assert view["counters"]["io.split.records"]["delta"] >= 0
+    ts_times = [
+        s["t"]
+        for s in agg.report()["timeseries"]["per_rank"]["3"]
+    ]
+    assert ts_times == sorted(ts_times)  # monotone after the relaunch
+
+
+def test_gauge_set_max_and_registry_peak_reset():
+    """The peak-gauge story (satellite): set_max keeps the high-water
+    mark, reset_peak_gauges rewinds ONLY set_max-style gauges at a
+    measurement-scope boundary — live inc/dec gauges are untouched."""
+    reg = MetricRegistry()
+    peak = reg.gauge("io.fetch.concurrency_peak")
+    live = reg.gauge("dsserve.queue_depth")
+    live.inc(4)
+    peak.set_max(8)
+    peak.set_max(3)       # lower reading never clobbers the peak
+    assert peak.value() == 8
+    assert reg.peak_gauge_values() == {"io.fetch.concurrency_peak": 8.0}
+    assert reg.reset_peak_gauges() == 1
+    assert peak.value() == 0.0
+    assert live.value() == 4.0  # live accounting survived the rewind
+    peak.set_max(5)       # the next scope records ITS peak
+    assert peak.value() == 5.0

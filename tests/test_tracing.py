@@ -532,3 +532,112 @@ def test_submit_run_merges_workers_daemon_and_tracker(tmp_path):
     assert rep["busy_seconds_by_stage"], rep
     assert rep["threads"]
     assert len(rep["starvation_gaps"]) >= 1, rep
+
+
+# -- causal RPC trace context (ISSUE 14) ---------------------------------------
+
+
+def test_trace_context_roundtrip_and_malformed(fresh):
+    ctx = tracing.rpc_context()
+    dec = tracing.decode_context(ctx)
+    assert dec is not None and dec[0] > 0 and dec[1] > 0
+    assert tracing.encode_context(*dec) == ctx
+    # malformed contexts cost the arrow, never an exception
+    for bad in (None, "", "zz", "123", "a" * 33, "g" * 16 + "-" + "f" * 16,
+                42, b"x"):
+        assert tracing.decode_context(bad) is None
+        tracing.handler_flow(bad)  # no-op, no raise
+
+
+def test_flow_events_bind_wait_span_to_handler_span(fresh):
+    """The export contract Perfetto needs: the client's "s" flow is
+    temporally inside its wait span, the server's "f" (same id, same
+    cat, bp=e) inside the handler span."""
+    with tracing.span("dmlc:lookup_wait"):
+        ctx = tracing.rpc_context()
+    with tracing.handler_span("dmlc:lookup_lookup", ctx):
+        time.sleep(0.001)
+    trace = tracing.to_chrome_trace()
+    evs = trace["traceEvents"]
+    starts = [e for e in evs if e["ph"] == "s"]
+    ends = [e for e in evs if e["ph"] == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    s, f = starts[0], ends[0]
+    assert s["id"] == f["id"] and s["cat"] == f["cat"] == "dmlc.flow"
+    assert s["name"] == f["name"]
+    assert f["bp"] == "e"
+    wait = next(e for e in evs if e.get("name") == "dmlc:lookup_wait")
+    handler = next(
+        e for e in evs if e.get("name") == "dmlc:lookup_lookup"
+    )
+    assert wait["ts"] <= s["ts"] <= wait["ts"] + wait["dur"]
+    assert handler["ts"] <= f["ts"] <= handler["ts"] + handler["dur"]
+    # the handler span records the context for grep-ability
+    assert handler["args"]["tc"] == ctx
+    # and the flow id IS the context's span id
+    assert int(s["id"], 16) == tracing.decode_context(ctx)[1]
+
+
+def test_binary_flow_ids_for_frame_protocols(fresh):
+    """The collective's DCL1 header carries the raw 64-bit id."""
+    with tracing.span("send_side"):
+        fid = tracing.flow_send_id()
+    assert fid > 0
+    with tracing.span("dmlc:allreduce_wait"):
+        tracing.flow_recv(fid)
+    tracing.flow_recv(0)  # recorder-off sender: no event, no raise
+    evs = tracing.to_chrome_trace()["traceEvents"]
+    assert [e["ph"] for e in evs if e["ph"] in "sf"] == ["s", "f"]
+    s, f = (e for e in evs if e["ph"] in "sf")
+    assert s["id"] == f["id"] == f"{fid:x}"
+
+
+def test_rpc_context_none_when_disabled(fresh):
+    tracing.set_enabled(False)
+    assert tracing.rpc_context() is None
+    assert tracing.flow_send_id() == 0
+
+
+def test_wait_spans_mirror_into_stall_counters(fresh):
+    """Completed wait-stage spans tick trace.stall_seconds{stage=} —
+    the registry mirror the windowed stall-fraction query reads."""
+    from dmlc_core_tpu.telemetry import default_registry
+
+    key = 'trace.stall_seconds{stage="shard_lease_wait"}'
+    before = default_registry().counter_values(names=[key]).get(key, 0.0)
+    with tracing.span("dmlc:shard_lease_wait"):
+        time.sleep(0.01)
+    with tracing.span("dmlc:window_load"):  # busy stage: NOT mirrored
+        time.sleep(0.001)
+    after = default_registry().counter_values(names=[key])[key]
+    assert after - before >= 0.009
+    busy = default_registry().counter_values(
+        names=['trace.stall_seconds{stage="window_load"}']
+    )
+    assert not busy
+
+
+def test_clock_offset_recorded_and_merge_aligns(fresh, tmp_path):
+    tracing.set_clock_offset(2_000_000.0)  # this process runs 2ms fast
+    tracing.instant("dmlc:mark")
+    trace = tracing.to_chrome_trace()
+    assert trace["otherData"]["clock_offset_ns"] == 2_000_000.0
+    assert trace["otherData"]["clock_offset_source"] == "heartbeat_rtt"
+    raw_ts = next(
+        e["ts"] for e in trace["traceEvents"] if e["ph"] == "i"
+    )
+    # default merge: timestamps untouched (same-host runs)
+    merged = tracing.merge_traces([trace])
+    assert any(
+        e.get("ts") == raw_ts for e in merged["traceEvents"]
+    )
+    # align_clocks subtracts the offset (ns -> us)
+    aligned = tracing.merge_traces([trace], align_clocks=True)
+    shifted = next(
+        e["ts"] for e in aligned["traceEvents"] if e["ph"] == "i"
+    )
+    assert shifted == pytest.approx(raw_ts - 2000.0)
+    # per-file otherData (offset included) is preserved for forensics
+    assert aligned["otherData"]["processes"][0]["clock_offset_ns"] == (
+        2_000_000.0
+    )

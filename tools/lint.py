@@ -114,6 +114,20 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  sizing and the budget; route decode
                                  work through codec's pool and remote
                                  reads through SpanFetcher.)
+  L017 trace-context encode/decode outside telemetry/tracing.py (the
+                                 causal RPC trace context — 16-hex-
+                                 digit trace/span ids, "trace-span" on
+                                 the wire — is encoded and decoded in
+                                 exactly one module: tracing.py's
+                                 encode_context/decode_context. A
+                                 hand-rolled 016x format or base-16
+                                 int parse elsewhere in the wire-
+                                 speaking trees (telemetry/, tracker/,
+                                 dsserve/, io/, tools/, staging/) can
+                                 drift the format and silently break
+                                 every flow arrow; carry the context
+                                 as the opaque string tracing hands
+                                 out.)
   L016 socket-serving request loops in dmlc_core_tpu/io/ (exactly two
                                  modules are sanctioned servers there:
                                  blockcache.py — the shared-cache
@@ -425,6 +439,18 @@ _L015_EXEMPT = (
     "/tracker/protocol.py",
     "/tracker/collective.py",
 )
+# L017 is scoped to the wire-speaking trees (everywhere a trace
+# context could plausibly be hand-rolled onto a protocol) and exempts
+# the flight recorder, which owns the context encoding
+_L017_SCOPE_DIRS = (
+    "dmlc_core_tpu/telemetry/",
+    "dmlc_core_tpu/tracker/",
+    "dmlc_core_tpu/dsserve/",
+    "dmlc_core_tpu/io/",
+    "dmlc_core_tpu/tools/",
+    "dmlc_core_tpu/staging/",
+)
+_L017_EXEMPT = ("/telemetry/tracing.py",)
 _L013_CMDS = frozenset(
     {
         "start",
@@ -694,6 +720,46 @@ def _check_socket_serving_loops(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+def _check_trace_context_codec(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Trace-context encode/decode primitives outside their owner:
+    the 16-hex-digit id format spec (any string literal containing the
+    marker, covering f-strings, %-format and str.format alike — the
+    spec constant of an f-string IS a string literal in the AST) and
+    base-16 ``int(x, 16)`` parsing. Both are how a module would
+    hand-roll telemetry/tracing.py's encode_context/decode_context;
+    alias games don't apply (``int`` is a builtin, the format marker is
+    a literal), so the two patterns are the whole surface. Scoped in
+    lint_file; tracing.py itself is exempt."""
+    hex16 = "016" + "x"  # not spelled whole, or this file flags itself
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and hex16 in node.value
+        ):
+            yield node.lineno, (
+                "16-hex-digit trace-id formatting outside "
+                "telemetry/tracing.py (use tracing.encode_context / "
+                "rpc_context and carry the string opaquely)"
+            )
+        elif isinstance(node, ast.Call) and (
+            isinstance(node.func, ast.Name) and node.func.id == "int"
+        ):
+            base = None
+            if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant):
+                base = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "base" and isinstance(kw.value, ast.Constant):
+                    base = kw.value.value
+            if base == 16:
+                yield node.lineno, (
+                    "base-16 id parsing outside telemetry/tracing.py "
+                    "(use tracing.decode_context; a second parser can "
+                    "drift the wire format and silently break every "
+                    "flow arrow)"
+                )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -711,6 +777,7 @@ CHECKS = [
     ("L014", _check_socket_construction),
     ("L015", _check_struct_framing),
     ("L016", _check_socket_serving_loops),
+    ("L017", _check_trace_context_codec),
 ]
 
 
@@ -810,6 +877,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L016_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L016_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L017":
+            if posix.endswith(_L017_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L017_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L017_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
