@@ -1250,6 +1250,254 @@ def _dsserve_remote_bench() -> dict:
     }
 
 
+# autoscale_phase_shift corpus (ISSUE 16): a small raw .rec whose drain
+# cost is set by injected fault:// latency, not CPU — the phase shift
+# (cheap -> expensive) is a URI swap, deterministic on any box
+AUTOSCALE_ROWS = int(os.environ.get("BENCH_AS_ROWS", "2000"))
+AUTOSCALE_DATA = f"/tmp/dmlc_tpu_bench_autoscale_{AUTOSCALE_ROWS}.rec"
+AUTOSCALE_INDEX = AUTOSCALE_DATA + ".idx"
+
+
+def ensure_autoscale_data() -> None:
+    if (os.path.exists(AUTOSCALE_DATA)
+            and os.path.getsize(AUTOSCALE_DATA) > 0
+            and os.path.exists(AUTOSCALE_INDEX)
+            and os.path.getsize(AUTOSCALE_INDEX) > 0):
+        return
+    from dmlc_core_tpu.data.rowrec import encode_row
+    from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter
+    from dmlc_core_tpu.io.stream import FileStream
+
+    tmp, tmpi = AUTOSCALE_DATA + ".tmp", AUTOSCALE_INDEX + ".tmp"
+    with FileStream(tmp, "w") as f, FileStream(tmpi, "w") as fi:
+        w = IndexedRecordIOWriter(f, fi)
+        rng = np.random.default_rng(7)
+        for i in range(AUTOSCALE_ROWS):
+            w.write_record(encode_row(
+                float(i % 2), rng.integers(0, 500, 8, dtype=np.int64),
+                rng.normal(size=8).astype(np.float32),
+            ), i)
+        w.flush_block()
+    os.replace(tmp, AUTOSCALE_DATA)
+    os.replace(tmpi, AUTOSCALE_INDEX)
+
+
+def _autoscale_drain_main(rec: str, idx: str) -> None:
+    """Worker mode (``bench.py --autoscale-drain rec idx``): one PACED
+    trainer draining a dsserve tier through a two-phase workload —
+    cheap epochs (plain reads; the paced consume loop is the
+    bottleneck, so the tier idles) then expensive epochs (every read
+    behind ``fault://`` injected latency; the tier is the bottleneck
+    and the trainer's recv-wait stall is the controller's scale-up
+    signal). Heartbeats ride the drain so the tracker SEES the stall
+    mid-epoch. Host-side only, no jax. Prints per-phase epoch secs,
+    total rows, and per-micro-shard slot shas from each phase's first
+    epoch (the cross-run identity anchor)."""
+    import hashlib
+
+    from dmlc_core_tpu.dsserve import DsServeBatches
+    from dmlc_core_tpu.staging.batcher import BatchSpec
+    from dmlc_core_tpu.tracker.client import RabitWorker
+
+    cheap = int(os.environ.get("BENCH_AS_CHEAP_EPOCHS", "2"))
+    expensive = int(os.environ.get("BENCH_AS_EXP_EPOCHS", "4"))
+    # sustained slow phase: spikes far above the per-open read count
+    # (the default 2 is two blips, not a phase) and a SMALL cap so one
+    # shard is many read ordinals — a spike lands every ~2.5 reads
+    # (io/faults.py schedule), so cap=512 puts ~3.4s of injected sleep
+    # per epoch on a 1-worker tier, well above the pacing floor
+    fault = os.environ.get(
+        "BENCH_AS_FAULT", "latency_ms=25,spikes=400,cap=512,seed=5"
+    )
+    pace_ms = float(os.environ.get("BENCH_AS_PACE_MS", "25"))
+    spec = BatchSpec(batch_size=64, layout="ell", max_nnz=8)
+    query = f"?index={idx}&shuffle=record&seed=3"
+    phase_uris = (
+        ("cheap", cheap, f"{rec}{query}"),
+        ("expensive", expensive, f"fault://{fault}{rec}{query}"),
+    )
+    w = RabitWorker()
+    w.start()
+    rows = 0
+    last_hb = 0.0
+    epoch = 0
+    phase_secs: dict = {}
+    shards: dict = {}
+    for phase, n_epochs, uri in phase_uris:
+        phase_secs[phase] = []
+        for i in range(n_epochs):
+            t0 = time.perf_counter()
+            src = DsServeBatches(
+                "dsserve://" + os.environ["DMLC_DSSERVE"] + "/" + uri,
+                spec, mode="lease", epoch=epoch,
+            )
+            if i == 0:  # the phase's identity epoch
+                shas: dict = {}
+                src.on_slot = lambda shard, seq, p, _s=shas: _s.setdefault(
+                    shard, hashlib.sha256()
+                ).update(p.tobytes())
+            for b in src:
+                rows += b.n_valid
+                if pace_ms:
+                    time.sleep(pace_ms / 1000.0)  # the simulated step
+                now = time.monotonic()
+                if now - last_hb > 0.2:
+                    w.heartbeat()
+                    last_hb = now
+            src.close()
+            if i == 0:
+                shards[phase] = {
+                    str(s): h.hexdigest() for s, h in shas.items()
+                }
+            phase_secs[phase].append(round(time.perf_counter() - t0, 3))
+            epoch += 1
+    w.heartbeat()
+    w.shutdown()
+    print(json.dumps({
+        "rows": rows, "phase_secs": phase_secs, "shards": shards,
+    }))
+
+
+def _autoscale_phase_shift_bench() -> dict:
+    """The ``autoscale_phase_shift`` config (ISSUE 16 acceptance): the
+    paced two-phase drain twice over REAL dsserve worker processes —
+
+    - **oracle**: a fixed fleet pre-sized at max (2 workers), no
+      controller — the hindsight-optimal capacity for the expensive
+      phase;
+    - **autoscaled**: the fleet opens at min (1 worker) with the
+      tracker's closed-loop controller live (DMLC_AUTOSCALE=1:2, the
+      elastic DsServeTier actuator); the fault://-latency phase must
+      provoke the scale-up.
+
+    Both runs sleep through the same injected latency and the same
+    pacing, so the expensive-phase makespan ratio measures the
+    CONTROLLER'S reaction cost (detection window + worker spawn), not
+    box weather. Invariants: autoscaled expensive-phase makespan
+    <= 1.25x oracle, >= 1 scale-up, <= 2 direction changes, and
+    rows + per-micro-shard slot shas IDENTICAL across runs (elastic
+    join mid-epoch is loss-free through the shard ledger)."""
+    from dmlc_core_tpu.tracker import autoscale as _as
+    from dmlc_core_tpu.tracker.backends.local import (
+        DsServeTier,
+        ElasticActuator,
+    )
+    from dmlc_core_tpu.tracker.tracker import RabitTracker
+
+    ensure_autoscale_data()
+    env_common = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "DMLC_TS_INTERVAL": "0.1",
+        "DMLC_TASK_ID": "0",
+    }
+    knobs = (
+        "DMLC_SHARD_OVERSPLIT", "DMLC_AUTOSCALE",
+        "DMLC_AUTOSCALE_INTERVAL", "DMLC_AUTOSCALE_WINDOW",
+        "DMLC_AUTOSCALE_DWELL",
+    )
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def run_mode(autoscaled: bool) -> tuple:
+        # tracker-process knobs, set BEFORE the tracker exists (the
+        # ShardService pins oversplit and the controller reads its
+        # config at start)
+        os.environ["DMLC_SHARD_OVERSPLIT"] = "6"
+        if autoscaled:
+            os.environ["DMLC_AUTOSCALE"] = "1:2"
+            os.environ["DMLC_AUTOSCALE_INTERVAL"] = "0.25"
+            os.environ["DMLC_AUTOSCALE_WINDOW"] = "1.5"
+            # dwell does NOT delay the first action, it spaces the
+            # ones after it: the scale-up lands as soon as the stall
+            # window fills, then a run-length dwell pins the fleet so
+            # windowed stall oscillation at 2 workers (and the low-
+            # stall drain tail) can't flap it back down mid-measure
+            os.environ["DMLC_AUTOSCALE_DWELL"] = "10"
+        else:
+            os.environ.pop("DMLC_AUTOSCALE", None)
+        tracker = None
+        tier = None
+        try:
+            tracker = RabitTracker("127.0.0.1", 1)
+            tracker.start(1)
+            tracker_env = {
+                "DMLC_TRACKER_URI": "127.0.0.1",
+                "DMLC_TRACKER_PORT": str(tracker.port),
+            }
+            tier = DsServeTier(
+                1 if autoscaled else 2, {**env_common, **tracker_env}
+            )
+            client_env = {
+                **env_common, **tracker_env,
+                "DMLC_DSSERVE": tier.endpoints,
+            }
+            if autoscaled:
+                # the controller inside THIS process's tracker drives
+                # the tier; the client learns of joins from the
+                # endpoints file (the dmlc-submit wiring, in-process)
+                _as.set_actuator(ElasticActuator(tier))
+                client_env["DMLC_DSSERVE_FILE"] = tier.endpoints_file
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--autoscale-drain", AUTOSCALE_DATA, AUTOSCALE_INDEX],
+                env=client_env, stdout=subprocess.PIPE, text=True,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"autoscale drain (autoscaled={autoscaled}) failed "
+                    f"(rc={proc.returncode}); stdout tail: "
+                    f"{proc.stdout[-500:]!r}"
+                )
+            out = json.loads(proc.stdout)
+            status = (
+                tracker.autoscaler.status() if tracker.autoscaler
+                else None
+            )
+            summary = tracker.shards.summary()
+            return out, status, summary
+        finally:
+            _as.set_actuator(None)
+            if tier is not None:
+                tier.stop()
+            if tracker is not None:
+                tracker.close()
+
+    try:
+        oracle, _unused, oracle_sum = run_mode(False)
+        auto, status, auto_sum = run_mode(True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    exp_oracle = sum(oracle["phase_secs"]["expensive"])
+    exp_auto = sum(auto["phase_secs"]["expensive"])
+    identical = (
+        oracle["rows"] == auto["rows"]
+        and oracle["shards"] == auto["shards"]
+    )
+    return {
+        "oracle": {
+            "phase_secs": oracle["phase_secs"], "rows": oracle["rows"],
+            "duplicates": oracle_sum.get("duplicates", 0),
+        },
+        "autoscaled": {
+            "phase_secs": auto["phase_secs"], "rows": auto["rows"],
+            "duplicates": auto_sum.get("duplicates", 0),
+        },
+        "identical": identical,
+        "scale_ups": (status or {}).get("decisions", {}).get(
+            "scale_up", 0
+        ),
+        "direction_changes": (status or {}).get("direction_changes", 0),
+        "cost_spent": (status or {}).get("cost_spent", 0.0),
+        "expensive_makespan_oracle": round(exp_oracle, 3),
+        "expensive_makespan_autoscaled": round(exp_auto, 3),
+        "makespan_ratio": round(exp_auto / max(exp_oracle, 1e-9), 2),
+    }
+
+
 def _allreduce_sgd_main(out: str) -> None:
     """Worker mode (``bench.py --allreduce-sgd out``): one rank of the
     ``allreduce_recovery`` SGD job — per-step "gradients" summed across
@@ -2147,6 +2395,21 @@ def main() -> None:
             # regression, never a capability skip
             dsserve_remote["failed"] = True
 
+    # closed-loop autoscaling under a phase shift (ISSUE 16
+    # acceptance): cheap epochs then a fault://-latency input-bound
+    # phase; the tracker's controller must grow the dsserve tier and
+    # land within 1.25x of an oracle fixed fleet on the expensive-phase
+    # makespan, rows and slot shas identical, <= 2 direction changes
+    try:
+        autoscale_shift = _autoscale_phase_shift_bench()
+    except Exception as e:
+        autoscale_shift = {"skipped": repr(e)}
+        if isinstance(e, (AssertionError, RuntimeError)):
+            # a drain worker crashing, a diverging drain or a dead
+            # controller is an autoscale regression, never a
+            # capability skip
+            autoscale_shift["failed"] = True
+
     # batched point reads vs the naive per-key open-seek-read loop over
     # the latency-injected corpus, plus the warm serve daemon under a
     # paced request load (ISSUE 13 acceptance: >= 5x, bytes
@@ -2288,6 +2551,38 @@ def main() -> None:
                 f"{dsserve_remote['dsserve_speedup']}x the all-local "
                 f"pipeline (invariant >= 1.5x)"
             )
+    # autoscale_phase_shift invariants (ISSUE 16): the closed-loop
+    # controller must react to the input-bound phase (>= 1 scale-up),
+    # not thrash (<= 2 direction changes), land within 1.25x of the
+    # oracle fixed fleet on the expensive-phase makespan, and elastic
+    # joins must be loss-free (rows + slot shas identical across runs)
+    if autoscale_shift.get("failed"):
+        failures.append(
+            f"autoscale_phase_shift: {autoscale_shift['skipped']}"
+        )
+    if "skipped" not in autoscale_shift:
+        if not autoscale_shift["identical"]:
+            failures.append(
+                "autoscale_phase_shift: autoscaled drain diverged from "
+                "the oracle fixed fleet (rows or per-shard slot sha)"
+            )
+        if not (autoscale_shift["scale_ups"] >= 1):
+            failures.append(
+                "autoscale_phase_shift: the input-bound phase provoked "
+                "no scale-up"
+            )
+        if not (autoscale_shift["direction_changes"] <= 2):
+            failures.append(
+                f"autoscale_phase_shift: controller thrashed "
+                f"({autoscale_shift['direction_changes']} direction "
+                f"changes, invariant <= 2)"
+            )
+        if not (autoscale_shift["makespan_ratio"] <= 1.25):
+            failures.append(
+                f"autoscale_phase_shift: expensive-phase makespan "
+                f"{autoscale_shift['makespan_ratio']}x the oracle "
+                f"fixed fleet (invariant <= 1.25x)"
+            )
     # point_lookup_zipf invariants (ISSUE 13): batched lookup must beat
     # the naive per-key open-seek-read loop >= 5x on the Zipfian
     # workload with bit-identical bytes, and the WARM serve daemon must
@@ -2400,6 +2695,14 @@ def main() -> None:
                 # on the latency-dominated drain, slot bytes identical
                 "dsserve_remote": dsserve_remote,
                 "dsserve_speedup": dsserve_remote.get("dsserve_speedup"),
+                # closed-loop autoscaling under a cheap -> fault://-
+                # latency phase shift (ISSUE 16): >= 1 scale-up, <= 2
+                # direction changes, expensive-phase makespan <= 1.25x
+                # the oracle fixed fleet, rows/shas identical
+                "autoscale_phase_shift": autoscale_shift,
+                "autoscale_makespan_ratio": autoscale_shift.get(
+                    "makespan_ratio"
+                ),
                 # batched point reads vs naive per-key random access on
                 # the Zipfian hot-set workload (ISSUE 13): >= 5x,
                 # bit-identical, served p99 ceiling at target QPS
@@ -2534,6 +2837,10 @@ if __name__ == "__main__":
         # worker mode: one trainer-side drain (all-local pipeline or
         # dsserve:// client), host-side only, no jax, no data generation
         _dsserve_drain_main(sys.argv[2], sys.argv[3], sys.argv[4])
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--autoscale-drain":
+        # worker mode: the paced two-phase (cheap -> fault-latency)
+        # dsserve drain of the autoscale bench, host-side only, no jax
+        _autoscale_drain_main(sys.argv[2], sys.argv[3])
     elif len(sys.argv) >= 3 and sys.argv[1] == "--allreduce-sgd":
         # worker mode: one rank of the allreduce_recovery SGD drill,
         # numpy-only, no data generation

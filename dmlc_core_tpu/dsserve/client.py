@@ -38,6 +38,7 @@ shared receive queue is the ``dsserve_recv_wait`` stall stage
 
 from __future__ import annotations
 
+import json
 import os
 import queue
 import socket
@@ -192,6 +193,7 @@ class DsServeBatches:
         self.on_slot = None
         self.on_shard_done = None
         self._threads: List[threading.Thread] = []
+        self._eps_lock = threading.Lock()
         for i in range(len(self.endpoints)):
             t = threading.Thread(
                 target=self._run_endpoint,
@@ -201,6 +203,63 @@ class DsServeBatches:
             )
             self._threads.append(t)
             t.start()
+        # elastic-tier discovery (lease mode only): the launcher
+        # maintains an endpoints file that the autoscale controller's
+        # scale-ups rewrite; polling it lets a mid-epoch spawn start
+        # streaming THIS epoch instead of idling until the next one
+        self._disco_thread: Optional[threading.Thread] = None
+        self._disco_path = os.environ.get("DMLC_DSSERVE_FILE", "")
+        if mode == "lease" and self._disco_path:
+            t = threading.Thread(
+                target=self._discover_loop,
+                daemon=True,
+                name="dsserve-discover",
+            )
+            self._disco_thread = t
+            t.start()
+
+    # -- elastic discovery ---------------------------------------------------
+    def _discover_loop(self) -> None:
+        """Poll ``DMLC_DSSERVE_FILE`` (atomically rewritten by the tier
+        on every scale-up/retire) and dial every endpoint not already
+        streamed. Membership only ever GROWS here: a retired server
+        ends its own streams with a retired EPOCH_END, and the ledger
+        re-serves anything it released — removal needs no client-side
+        action (docs/autoscale.md)."""
+        while True:
+            try:
+                with open(self._disco_path) as f:
+                    eps = json.load(f).get("endpoints", [])
+            except (OSError, ValueError):
+                eps = []  # mid-rewrite or not yet written; next poll
+            if isinstance(eps, list):
+                for ep in eps:
+                    host, colon, port = str(ep).rpartition(":")
+                    if colon and host and port.isdigit():
+                        self._add_endpoint(host, int(port))
+            # scan-first ordering: an epoch constructed AFTER a scale-up
+            # dials the grown fleet immediately, not a poll later
+            if self._kill.wait(0.5):
+                return
+
+    def _add_endpoint(self, host: str, port: int) -> None:
+        with self._eps_lock:
+            if (host, port) in self.endpoints:
+                return
+            i = len(self.endpoints)
+            # append order matters: __iter__'s end condition re-reads
+            # len(self.endpoints), so the state slot must exist before
+            # the list grows past it
+            self._eps.append(_EndpointState())
+            self.endpoints.append((host, port))
+        t = threading.Thread(
+            target=self._run_endpoint,
+            args=(i,),
+            daemon=True,
+            name=f"dsserve-recv-{i}",
+        )
+        self._threads.append(t)
+        t.start()
 
     # -- connection machinery ------------------------------------------------
     def _hello(self, i: int, start_seq: int) -> Dict:
@@ -511,3 +570,6 @@ class DsServeBatches:
                 break
         for t in self._threads:
             t.join(timeout=2.0)
+        if self._disco_thread is not None:
+            self._disco_thread.join(timeout=2.0)
+            self._disco_thread = None

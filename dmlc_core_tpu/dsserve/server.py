@@ -208,6 +208,7 @@ class DsServeServer:
         except ValueError:
             self._kill_after = 0
         self._closed = threading.Event()
+        self._retiring = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._streams: list = []
         self._depth_lock = threading.Lock()
@@ -229,6 +230,25 @@ class DsServeServer:
     def serve_forever(self) -> None:
         self._accept_loop()
 
+    def retire(self) -> None:
+        """Graceful retire (autoscale scale-down; the tier's SIGTERM):
+        stop accepting streams and stop taking NEW leases — each live
+        stream finishes the shard it is producing, FINs it, sends a
+        retired EPOCH_END, and its teardown releases every lease it
+        still holds. The fleet shrinks without a single shard waiting
+        out its lease TTL; survivors (and the ledger's ``epoch_done``
+        sentinel) cover the rest of the epoch (docs/autoscale.md).
+        Signal-handler safe: just sets a flag the loops poll."""
+        if not self._retiring.is_set():
+            self._retiring.set()
+            _tracing.instant(
+                "dmlc:dsserve_retire", rank=self.rank, port=self.port
+            )
+
+    @property
+    def retiring(self) -> bool:
+        return self._retiring.is_set()
+
     def close(self) -> None:
         self._closed.set()
         try:
@@ -248,6 +268,14 @@ class DsServeServer:
         # blocked accept(), so the loop polls the closed flag instead
         self._sock.settimeout(0.25)
         while not self._closed.is_set():
+            if self._retiring.is_set():
+                # no new streams; wait for the live ones to drain their
+                # current shard and EPOCH_END out, then return — which
+                # lets serve_forever() (the CLI) exit zero
+                if not any(s.is_alive() for s in self._streams):
+                    return
+                time.sleep(0.1)
+                continue
             try:
                 conn, addr = self._sock.accept()
             except socket.timeout:
@@ -458,6 +486,13 @@ class DsServeServer:
 
         def _produce():
             while True:
+                if self._retiring.is_set():
+                    # retire boundary: the shard that was producing has
+                    # fully yielded (this check sits between shards), so
+                    # the client gets its FIN and can commit; everything
+                    # still leased is released by the stream teardown
+                    yield ("epoch_end", True)
+                    return
                 resp = lease_client.lease(epoch, cfg.fileset)
                 status = resp.get("status")
                 if status == "lease":
@@ -522,9 +557,11 @@ class DsServeServer:
                         seq=seq, epoch=epoch,
                     )
                 else:  # epoch_end
+                    meta = {"slots": seq}
+                    if len(item) > 1 and item[1]:
+                        meta["retired"] = True
                     wire.send_frame(
-                        conn, wire.KIND_EPOCH_END, {"slots": seq},
-                        epoch=epoch,
+                        conn, wire.KIND_EPOCH_END, meta, epoch=epoch,
                     )
                     return
         finally:

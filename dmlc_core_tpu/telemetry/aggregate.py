@@ -157,6 +157,13 @@ class ClusterAggregator:
         #: own registry samples ride it under the ``tracker`` pseudo-
         #: rank (how queue-depth history reaches /metrics.json?window=)
         self.timeseries = ClusterTimeSeries()
+        #: extra report sections contributed by OTHER subsystems:
+        #: name -> zero-arg callable returning a JSON-able dict,
+        #: evaluated per report. The tracker registers its autoscale
+        #: controller's status here ("autoscale"), keeping telemetry
+        #: free of tracker imports. A failing section is dropped, not
+        #: fatal — a status bug must never break /metrics.json.
+        self.extra_sections: Dict[str, Any] = {}
 
     def update(self, rank: int, payload) -> None:
         """Record ``payload`` (a snapshot dict or its JSON string) as
@@ -212,6 +219,11 @@ class ClusterAggregator:
             out["windowed"] = self.windowed(window)
         else:
             out["timeseries"] = self.timeseries.report()
+        for name, section in list(self.extra_sections.items()):
+            try:
+                out[str(name)] = section()
+            except Exception:
+                logger.exception("report section %r failed", name)
         return out
 
     def prometheus(self) -> str:

@@ -445,6 +445,11 @@ def _cmd_dsserve(args) -> int:
       (``DMLC_TRACKER_URI``/``PORT``) the server leases micro-shards;
       ``--port-file`` writes the bound endpoint as a JSON readiness
       signal for launchers; ``--port 0`` binds any free port.
+      SIGTERM is the GRACEFUL retire signal (docs/autoscale.md): the
+      server finishes the shard it is producing, EPOCH_ENDs its
+      streams, releases every held lease, then exits zero — so an
+      autoscale scale-down (or operator drain) never strands a lease
+      to its TTL.
     """
     import json
     import signal
@@ -456,7 +461,7 @@ def _cmd_dsserve(args) -> int:
     server = DsServeServer(args.host, args.port, rank=args.rank)
     if args.port_file:
         write_port_file(args.port_file, args.host, server.port)
-    signal.signal(signal.SIGTERM, lambda *_a: server.close())
+    signal.signal(signal.SIGTERM, lambda *_a: server.retire())
     print(
         f"dsserve worker pid {os.getpid()} rank {server.rank} serving "
         f"{args.host}:{server.port}"
@@ -710,6 +715,10 @@ def _top_model(report: dict, window: float) -> dict:
     }
     if qd is not None:
         model["shard_queue_depth"] = qd
+    # autoscale controller status (tracker/autoscale.py registers it as
+    # a report section; absent on fixed-fleet jobs)
+    if isinstance(report.get("autoscale"), dict):
+        model["autoscale"] = report["autoscale"]
     return model
 
 
@@ -753,6 +762,27 @@ def _render_top(model: dict, endpoint: str) -> str:
     if "dsserve_slots_per_sec" in cd:
         summary.append(f"dsserve {cd['dsserve_slots_per_sec']:g} slots/s")
     lines.append("  ".join(summary))
+    asc = model.get("autoscale")
+    if asc:
+        parts = [
+            f"autoscale fleet {asc.get('actual', 0)}→"
+            f"{asc.get('target', 0)} "
+            f"(bounds {asc.get('min_workers', 0)}:"
+            f"{asc.get('max_workers', 0)})"
+        ]
+        last = asc.get("last") or {}
+        if last:
+            parts.append(
+                f"last {last.get('kind', '?')} ({last.get('reason', '?')})"
+            )
+        ceiling = asc.get("cost_ceiling") or 0
+        parts.append(
+            f"cost {asc.get('cost_spent', 0.0):.0f}"
+            + (f"/{ceiling:g} ws" if ceiling else " ws")
+        )
+        if asc.get("direction_changes"):
+            parts.append(f"flaps {asc['direction_changes']}")
+        lines.append("  ".join(parts))
     lines.append("")
     lines.append(f"{'rank':>8}  {'rows/s':>10}  stall by stage")
     for rank, r in (model.get("ranks") or {}).items():
@@ -807,6 +837,72 @@ def _cmd_top(args) -> int:
             _time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:
         return 0
+
+
+def _cmd_autoscale(args) -> int:
+    """Offline surface for the elastic controller (tracker/autoscale.py,
+    docs/autoscale.md):
+
+    - ``replay <metrics-report.json>``: run the PURE decision function
+      over the time series recorded in an end-of-job report
+      (``DMLC_METRICS_REPORT``) and print the decisions it would have
+      made — deterministic and offline, so thresholds/dwell/ceiling can
+      be tuned against yesterday's job without rerunning it. The
+      simulated fleet tracks the decisions, so the printed cost is the
+      plan's worker×seconds spend.
+    """
+    import json as _json
+
+    from ..tracker import autoscale as _as
+
+    with open(args.report) as f:
+        report = _json.load(f)
+    ts = report.get("timeseries")
+    if not isinstance(ts, dict) or not ts.get("per_rank"):
+        print(
+            "error: report has no retained time series — need the "
+            "end-of-job DMLC_METRICS_REPORT shape (a run with DMLC_TS "
+            "sampling on)",
+            file=sys.stderr,
+        )
+        return 1
+    lo, sep, hi = str(args.fleet).partition(":")
+    try:
+        cfg = _as.AutoscaleConfig(
+            min_workers=int(lo),
+            max_workers=int(hi if sep else lo),
+            up_threshold=args.up,
+            down_threshold=args.down,
+            dwell_secs=args.dwell,
+            cost_ceiling=args.cost_ceiling,
+            interval=max(0.1, args.interval),
+            window=max(0.5, args.window),
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    decisions = _as.replay(ts, cfg, include_holds=not args.actions_only)
+    if args.json:
+        print(_json.dumps(decisions, indent=1))
+        return 0
+    for d in decisions:
+        print(
+            f"t+{d['t']:8.1f}s  {d['kind']:<10} {d['reason']:<14} "
+            f"target={d['target']}  input {d.get('input_stall', 0.0):.2f}  "
+            f"compute {d.get('compute_stall', 0.0):.2f}  "
+            f"queue {d.get('queue_depth', 0.0):g}  "
+            f"cost {d['cost_spent']:.0f}ws"
+        )
+    kinds = {}
+    for d in decisions:
+        kinds[d["kind"]] = kinds.get(d["kind"], 0) + 1
+    total = decisions[-1]["cost_spent"] if decisions else 0.0
+    print(
+        f"# {len(decisions)} decisions "
+        f"({', '.join(f'{k} {n}' for k, n in sorted(kinds.items()))}); "
+        f"plan cost {total:.0f} worker-seconds"
+    )
+    return 0
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -1049,6 +1145,53 @@ def build_arg_parser() -> argparse.ArgumentParser:
         help="with --once: print the derived model as JSON",
     )
     top.set_defaults(fn=_cmd_top)
+
+    asc = sub.add_parser(
+        "autoscale",
+        help="offline elastic-controller tools (replay recorded runs)",
+    )
+    asc.add_argument("action", choices=["replay"])
+    asc.add_argument(
+        "report",
+        help="end-of-job metrics report JSON (DMLC_METRICS_REPORT)",
+    )
+    asc.add_argument(
+        "--fleet", default="1:4", metavar="MIN:MAX",
+        help="fleet bounds to simulate (default 1:4)",
+    )
+    asc.add_argument(
+        "--up", default=0.40, type=float,
+        help="input-stall fraction that triggers scale-up (default 0.40)",
+    )
+    asc.add_argument(
+        "--down", default=0.10, type=float,
+        help="input-stall fraction that triggers retire (default 0.10)",
+    )
+    asc.add_argument(
+        "--dwell", default=10.0, type=float,
+        help="minimum seconds between scale actions (default 10)",
+    )
+    asc.add_argument(
+        "--cost-ceiling", default=0.0, type=float,
+        help="worker-seconds budget (0 = unlimited)",
+    )
+    asc.add_argument(
+        "--interval", default=2.0, type=float,
+        help="controller tick to simulate (default 2)",
+    )
+    asc.add_argument(
+        "--window", default=10.0, type=float,
+        help="windowed-view width per decision (default 10)",
+    )
+    asc.add_argument(
+        "--actions-only", action="store_true",
+        help="print only scale actions, not holds",
+    )
+    asc.add_argument(
+        "--json", action="store_true",
+        help="emit the decision list as JSON",
+    )
+    asc.set_defaults(fn=_cmd_autoscale)
 
     ck = sub.add_parser(
         "ckpt", help="inspect/prune checkpoint directories (any URI)"

@@ -17,12 +17,15 @@ import json
 import logging
 import os
 import shutil
+import signal
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 from typing import Dict, List, Optional
 
+from .. import autoscale as _autoscale
 from .. import collective, shardsvc
 from ..supervisor import Supervisor, default_max_attempt
 from . import run_tracker_submit
@@ -70,6 +73,18 @@ class HostBlockCache:
         shutil.rmtree(self._sock_dir, ignore_errors=True)
 
 
+class _DsWorker:
+    """One elastic-tier worker process and its identity."""
+
+    __slots__ = ("proc", "task_id", "port_file", "endpoint")
+
+    def __init__(self, proc, task_id: int, port_file: str) -> None:
+        self.proc = proc
+        self.task_id = task_id
+        self.port_file = port_file
+        self.endpoint: str = ""
+
+
 class DsServeTier:
     """The job's disaggregated preprocessing tier (``dmlc-submit
     --dsserve N``): N ``tools dsserve serve`` worker processes next to
@@ -80,52 +95,160 @@ class DsServeTier:
     ``dsserve://$DMLC_DSSERVE/<dataset-uri>``; ``stop()`` tears the
     tier down with the job. Lease identities start at task id 1000 so
     they can never collide with trainer ranks (a collision would let a
-    trainer heartbeat renew a server's leases)."""
+    trainer heartbeat renew a server's leases).
+
+    The tier is ELASTIC (docs/autoscale.md): ``add_worker`` spawns one
+    more server, ``retire_worker`` SIGTERMs the newest one (the server
+    finishes its shard, releases its leases and exits; past the grace
+    window it is killed and ``shardsvc.release_task`` frees its leases
+    immediately). The live membership is mirrored into
+    ``endpoints_file`` — an atomically rewritten JSON the clients poll
+    via ``DMLC_DSSERVE_FILE`` so a mid-epoch spawn gets dialed without
+    waiting for the next epoch."""
 
     def __init__(
         self, n: int, envs: Dict[str, object], host: str = "127.0.0.1"
     ) -> None:
         self._dir = tempfile.mkdtemp(prefix="dmlc-dsserve-")
-        self._procs: List[subprocess.Popen] = []
-        port_files = []
-        for i in range(n):
-            pf = os.path.join(self._dir, f"server{i}.port")
-            port_files.append(pf)
-            env = os.environ.copy()
-            for k, v in envs.items():
-                env[str(k)] = str(v)
-            env["DMLC_TASK_ID"] = str(1000 + i)
-            self._procs.append(subprocess.Popen([
-                sys.executable, "-m", "dmlc_core_tpu.tools", "dsserve",
-                "serve", "--host", host, "--port", "0",
-                "--port-file", pf,
-            ], env=env))
-        endpoints = []
-        deadline = time.monotonic() + 15.0
+        self._lock = threading.Lock()
+        self._envs = {str(k): str(v) for k, v in envs.items()}
+        self._host = host
+        self._next_id = 1000
+        self._workers: List[_DsWorker] = []
+        self._retirees: List[_DsWorker] = []
+        self.endpoints_file = os.path.join(self._dir, "endpoints.json")
         try:
-            for i, pf in enumerate(port_files):
-                while not os.path.exists(pf):
-                    if (self._procs[i].poll() is not None
-                            or time.monotonic() > deadline):
-                        raise RuntimeError(
-                            f"dsserve worker {i} failed to start "
-                            f"(port file {pf} never appeared)"
-                        )
-                    time.sleep(0.05)
-                with open(pf) as f:
-                    ep = json.load(f)
-                endpoints.append(f"{ep['host']}:{ep['port']}")
+            spawned = [self._spawn() for _ in range(n)]
+            deadline = time.monotonic() + 15.0
+            for w in spawned:
+                self._await_port(w, deadline)
         except BaseException:
             self.stop()
             raise
-        self.endpoints = ",".join(endpoints)
+        self._write_endpoints()
         logger.info("dsserve tier serving at %s", self.endpoints)
 
+    @property
+    def endpoints(self) -> str:
+        with self._lock:
+            return ",".join(w.endpoint for w in self._workers if w.endpoint)
+
+    def _spawn(self) -> _DsWorker:
+        with self._lock:
+            task_id = self._next_id
+            self._next_id += 1
+        pf = os.path.join(self._dir, f"server{task_id}.port")
+        env = os.environ.copy()
+        env.update(self._envs)
+        env["DMLC_TASK_ID"] = str(task_id)
+        proc = subprocess.Popen([
+            sys.executable, "-m", "dmlc_core_tpu.tools", "dsserve",
+            "serve", "--host", self._host, "--port", "0",
+            "--port-file", pf,
+        ], env=env)
+        w = _DsWorker(proc, task_id, pf)
+        with self._lock:
+            self._workers.append(w)
+        return w
+
+    def _await_port(self, w: _DsWorker, deadline: float) -> None:
+        while not os.path.exists(w.port_file):
+            if (w.proc.poll() is not None
+                    or time.monotonic() > deadline):
+                raise RuntimeError(
+                    f"dsserve worker task {w.task_id} failed to start "
+                    f"(port file {w.port_file} never appeared)"
+                )
+            time.sleep(0.05)
+        with open(w.port_file) as f:
+            ep = json.load(f)
+        w.endpoint = f"{ep['host']}:{ep['port']}"
+
+    def _write_endpoints(self) -> None:
+        """Atomic rewrite (tmp + rename, the write_port_file idiom) so
+        a client's discovery poll can never read a partial list."""
+        with self._lock:
+            eps = [w.endpoint for w in self._workers if w.endpoint]
+        tmp = self.endpoints_file + f".tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"endpoints": eps}, f)
+        os.replace(tmp, self.endpoints_file)
+
+    def n_live(self) -> int:
+        with self._lock:
+            return sum(
+                1 for w in self._workers
+                if w.endpoint and w.proc.poll() is None
+            )
+
+    def add_worker(self, timeout: float = 15.0) -> str:
+        """Scale-up actuation: one more server, blocking until it binds
+        (so the controller's actual-fleet gauge is truthful by its next
+        tick) and published to the discovery file."""
+        w = self._spawn()
+        try:
+            self._await_port(w, time.monotonic() + timeout)
+        except BaseException:
+            with self._lock:
+                if w in self._workers:
+                    self._workers.remove(w)
+            if w.proc.poll() is None:
+                w.proc.kill()
+                w.proc.wait()
+            raise
+        self._write_endpoints()
+        logger.info(
+            "dsserve tier scaled up: +%s (task %d)", w.endpoint, w.task_id
+        )
+        return w.endpoint
+
+    def retire_worker(self, grace: float = 30.0) -> Optional[str]:
+        """Scale-down actuation: SIGTERM the newest live worker — the
+        server's retire path finishes its current shard, EPOCH_ENDs its
+        streams, releases its leases and exits zero. A worker that
+        outlives ``grace`` is killed and its leases released through
+        ``shardsvc.release_task`` so nothing waits out a TTL. Returns
+        the retired endpoint, or None when the tier is empty."""
+        with self._lock:
+            live = [w for w in self._workers if w.proc.poll() is None]
+            if not live:
+                return None
+            w = live[-1]
+            self._workers.remove(w)
+            self._retirees.append(w)
+        self._write_endpoints()
+        try:
+            w.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            pass
+        threading.Thread(
+            target=self._reap, args=(w, grace), daemon=True,
+            name="dsserve-retire",
+        ).start()
+        logger.info(
+            "dsserve tier retiring %s (task %d)", w.endpoint, w.task_id
+        )
+        return w.endpoint
+
+    def _reap(self, w: _DsWorker, grace: float) -> None:
+        try:
+            w.proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            logger.warning(
+                "dsserve worker task %d ignored retire for %.0fs; killing "
+                "and releasing its leases", w.task_id, grace,
+            )
+            w.proc.kill()
+            w.proc.wait()
+            shardsvc.release_task(w.task_id, self._host)
+
     def stop(self) -> None:
-        for p in self._procs:
+        with self._lock:
+            procs = [w.proc for w in self._workers + self._retirees]
+        for p in procs:
             if p.poll() is None:
                 p.terminate()
-        for p in self._procs:
+        for p in procs:
             if p.poll() is None:
                 try:
                     p.wait(timeout=5)
@@ -133,6 +256,26 @@ class DsServeTier:
                     p.kill()
                     p.wait()
         shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class ElasticActuator:
+    """The local backend's arm of the autoscale loop: the controller's
+    abstract fleet verbs mapped onto the tier (registered through
+    ``autoscale.set_actuator`` so tracker/autoscale.py needs no backend
+    import). Bounds live in the controller; this only actuates."""
+
+    def __init__(self, tier: DsServeTier, retire_grace: float = 30.0) -> None:
+        self.tier = tier
+        self.retire_grace = retire_grace
+
+    def actual(self) -> int:
+        return self.tier.n_live()
+
+    def add_task(self) -> bool:
+        return bool(self.tier.add_worker())
+
+    def retire_task(self) -> bool:
+        return self.tier.retire_worker(self.retire_grace) is not None
 
 
 def make_launcher(
@@ -184,13 +327,28 @@ def submit(args) -> None:
             cache = HostBlockCache(getattr(args, "block_cache_mb", 0))
             envs = dict(envs)
             envs["DMLC_BLOCK_CACHE_SOCK"] = cache.sock_path
-        if int(getattr(args, "dsserve", 0) or 0) > 0:
+        n_ds = int(getattr(args, "dsserve", 0) or 0)
+        # --autoscale min:max sizes the initial fleet here and registers
+        # the actuator; the tracker-side controller reads the same
+        # bounds from DMLC_AUTOSCALE (exported by submit.py before the
+        # tracker started in this very process)
+        as_bounds = None
+        if getattr(args, "autoscale", ""):
+            lo, sep, hi = str(args.autoscale).partition(":")
+            as_bounds = (int(lo), int(hi if sep else lo))
+            n_ds = max(
+                as_bounds[0], min(as_bounds[1], n_ds or as_bounds[0])
+            )
+        if n_ds > 0:
             dsserve = DsServeTier(
-                int(args.dsserve), envs,
+                n_ds, envs,
                 host=getattr(args, "dsserve_host", "127.0.0.1"),
             )
             envs = dict(envs)
             envs["DMLC_DSSERVE"] = dsserve.endpoints
+            if as_bounds is not None:
+                envs["DMLC_DSSERVE_FILE"] = dsserve.endpoints_file
+                _autoscale.set_actuator(ElasticActuator(dsserve))
         # --local-num-attempt retries == max_attempt total runs - 1
         # (reference local.py retry budget); DMLC_MAX_ATTEMPT wins if set.
         # localhost is one shared host, not a failure domain — per-task
@@ -226,6 +384,7 @@ def submit(args) -> None:
             abort_check=lambda: checks[0]() if checks else None,
         )
     finally:
+        _autoscale.set_actuator(None)
         if dsserve is not None:
             dsserve.stop()
         if cache is not None:

@@ -145,6 +145,33 @@ def get_opts(args: Optional[List[str]] = None):
         "--dsserve-host", default="127.0.0.1", type=str,
         help="Bind/advertise address for the dsserve tier.",
     )
+    # closed-loop elastic autoscaling (tracker/autoscale.py,
+    # docs/autoscale.md): the tracker's controller thread reads the
+    # windowed stall attribution and grows/shrinks the dsserve tier
+    parser.add_argument(
+        "--autoscale", default="", type=str, metavar="MIN:MAX",
+        help="Autoscale the dsserve tier between MIN and MAX workers "
+             "(exports DMLC_AUTOSCALE; default off — fixed fleet). The "
+             "tracker scales up when the input-stall fraction "
+             "(shard_lease_wait + dsserve_recv_wait + fetch_wait) "
+             "crosses the up threshold and retires workers gracefully "
+             "when the job is accelerator-bound (docs/autoscale.md). "
+             "Requires time-series sampling (DMLC_TS, on by default) "
+             "and MIN >= 1. --dsserve N inside the bounds sets the "
+             "opening fleet.",
+    )
+    parser.add_argument(
+        "--autoscale-cost-ceiling", default=0.0, type=float,
+        metavar="WORKER_SECS",
+        help="Hard elastic-tier budget in worker x seconds (exports "
+             "DMLC_AUTOSCALE_COST_CEILING; 0 = unlimited). Once spent, "
+             "scale-ups stop; running workers keep running.",
+    )
+    parser.add_argument(
+        "--autoscale-dwell", default=0.0, type=float, metavar="SECS",
+        help="Minimum seconds between scale actions (exports "
+             "DMLC_AUTOSCALE_DWELL; default 10) — the flap damper.",
+    )
     # flight-recorder tracing (telemetry/tracing.py): one trace file
     # per process of the job — workers, cache daemon, tracker — all
     # landing in one directory for `tools trace merge`
@@ -185,6 +212,18 @@ def get_opts(args: Optional[List[str]] = None):
         raise RuntimeError(
             "--cluster is not specified; set it or $DMLC_SUBMIT_CLUSTER"
         )
+    if parsed.autoscale:
+        lo, sep, hi = parsed.autoscale.partition(":")
+        try:
+            a_min, a_max = int(lo), int(hi if sep else lo)
+        except ValueError:
+            parser.error(
+                f"--autoscale {parsed.autoscale!r}: want MIN:MAX (e.g. 1:4)"
+            )
+        # MIN 0 would let the controller retire the whole tier mid-
+        # epoch, ending every client stream with nothing left to dial
+        if not 1 <= a_min <= a_max:
+            parser.error("--autoscale needs 1 <= MIN <= MAX")
     parsed.worker_memory_mb = get_memory_mb(parsed.worker_memory)
     parsed.server_memory_mb = get_memory_mb(parsed.server_memory)
     return parsed

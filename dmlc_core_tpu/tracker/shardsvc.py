@@ -537,6 +537,16 @@ class ShardService:
             self._update_queue_gauge()
             return n
 
+    def release_rank(self, rank: int) -> int:
+        """Voluntary rank-wide hand-back — the retire path's cooperative
+        twin of ``reclaim_rank`` (docs/autoscale.md): a departing
+        leaseholder (autoscale scale-down, operator drain) returns every
+        lease it still holds across all live epochs. Shards it already
+        streamed stay committed, and a ``record_done`` that lands after
+        the release is still honored through ``reclaimed_from`` — the
+        exactly-once contract survives the retire."""
+        return self.reclaim_rank(rank)
+
     def note_task_rank(self, jobid: str, rank: int) -> None:
         """Tracker feed at rank assignment: launcher task id (the jobid
         of the rendezvous preamble) → rendezvous rank, so task-keyed
@@ -655,6 +665,18 @@ def reclaim_task(task_id: int, host: str) -> None:
     service = active_service()
     if service is not None:
         service.reclaim_rank(service.resolve_task(task_id))
+
+
+def release_task(task_id: int, host: str = "") -> None:
+    """Elastic-retire escalation hook (backends/local.py): a retiring
+    worker that blew through its drain grace and had to be killed gets
+    its leases released NOW instead of waiting out the TTL — the
+    graceful path (``DsServeServer.retire``) releases them itself, so
+    this only fires on the kill branch. Same task→rank translation as
+    ``reclaim_task``; no-op when no shard service is live."""
+    service = active_service()
+    if service is not None:
+        service.release_rank(service.resolve_task(task_id))
 
 
 # -- worker-side client --------------------------------------------------------

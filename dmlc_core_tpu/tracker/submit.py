@@ -43,6 +43,17 @@ def main(argv: Optional[List[str]] = None) -> None:
         os.environ["DMLC_SHARD_OVERSPLIT"] = str(args.shard_oversplit)
     if getattr(args, "shard_lease_ttl", 0.0):
         os.environ["DMLC_SHARD_LEASE_TTL"] = str(args.shard_lease_ttl)
+    if getattr(args, "autoscale", ""):
+        # the tracker (this process) reads DMLC_AUTOSCALE when it
+        # starts its controller thread; the backend sizes the initial
+        # dsserve fleet from the same bounds (docs/autoscale.md)
+        os.environ["DMLC_AUTOSCALE"] = str(args.autoscale)
+        if getattr(args, "autoscale_cost_ceiling", 0.0):
+            os.environ["DMLC_AUTOSCALE_COST_CEILING"] = str(
+                args.autoscale_cost_ceiling
+            )
+        if getattr(args, "autoscale_dwell", 0.0):
+            os.environ["DMLC_AUTOSCALE_DWELL"] = str(args.autoscale_dwell)
     if getattr(args, "trace_dir", None):
         # one env export covers every process of the job: the tracker
         # (this process), workers and the block-cache daemon inherit

@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..telemetry import ClusterAggregator, serve_metrics
 from ..telemetry import timeseries as _timeseries
 from ..telemetry import tracing as _tracing
+from . import autoscale as _autoscale
 from . import collective as _collective
 from . import shardsvc as _shardsvc
 from .protocol import (
@@ -366,6 +367,9 @@ class RabitTracker:
         # collective.notify_task_failure without tracker wiring.
         self.watch = _collective.DeathWatch()
         _collective.set_active_watch(self.watch)
+        # elastic autoscale controller (autoscale.py, docs/autoscale.md):
+        # constructed in start() from DMLC_AUTOSCALE; None = fixed fleet
+        self.autoscaler: Optional[_autoscale.AutoscaleController] = None
         logger.info("start listen on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, object]:
@@ -796,6 +800,26 @@ class RabitTracker:
                 logger.warning("telemetry endpoint disabled: %s", e)
         if _timeseries.sampling_enabled():
             self._ts_ring.start()
+        # closed-loop autoscale (DMLC_AUTOSCALE=min:max, dmlc-submit
+        # --autoscale): the controller reads the windowed cluster view
+        # this aggregator already keeps and publishes its status as the
+        # report's "autoscale" section. A malformed spec degrades to a
+        # fixed fleet — never a dead tracker.
+        try:
+            as_cfg = _autoscale.AutoscaleConfig.from_env()
+        except ValueError as e:
+            logger.warning("autoscale disabled: %s", e)
+            as_cfg = None
+        if as_cfg is not None:
+            if not _timeseries.sampling_enabled():
+                logger.warning(
+                    "autoscale needs time-series sampling (DMLC_TS is "
+                    "off): controller will hold on no_signal"
+                )
+            self.autoscaler = _autoscale.AutoscaleController(
+                self.metrics, as_cfg
+            ).start()
+            self.metrics.extra_sections["autoscale"] = self.autoscaler.status
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="rabit-accept",
         )
@@ -820,6 +844,9 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+            self.autoscaler = None
         self._ts_ring.stop()
         if self._metrics_server is not None:
             self._metrics_server.shutdown()

@@ -715,3 +715,153 @@ def test_one_epoch_stream_guard(corpus):
         c.close()
     finally:
         srv.close()
+
+
+# -- graceful retire (the autoscale scale-down path) --------------------------
+
+
+def test_sigterm_graceful_retire_releases_leases_promptly(
+    corpus, tmp_path, monkeypatch
+):
+    """SIGTERM on a dsserve worker is the GRACEFUL retire signal
+    (docs/autoscale.md): the server finishes its in-flight shard, sends
+    a retired EPOCH_END on every stream, RELEASES every lease it still
+    holds back to the ledger and exits zero. Regression: the polite
+    exit used to close the socket with the leases still held, so the
+    survivor could only re-serve them after the full TTL — here the
+    TTL is pinned to 30s, so a TTL-wait would blow the promptness
+    assertion wide open."""
+    import signal as _signal
+
+    monkeypatch.setenv("DMLC_SHARD_OVERSPLIT", "6")
+    monkeypatch.setenv("DMLC_SHARD_LEASE_TTL", "30.0")
+    tr = RabitTracker("127.0.0.1", 1)
+    tr.start(1)
+    monkeypatch.setenv("DMLC_TRACKER_URI", "127.0.0.1")
+    monkeypatch.setenv("DMLC_TRACKER_PORT", str(tr.port))
+    monkeypatch.setenv("DMLC_TASK_ID", "0")
+    monkeypatch.delenv("DMLC_SHARD_RANK", raising=False)
+    rec, idx = corpus
+    # mild fault latency stretches the drain so the SIGTERM lands
+    # mid-stream with leases genuinely held (latency only — the bytes
+    # are untouched, so the clean local reference still matches)
+    slow_uri = (
+        f"fault://latency_ms=30,spikes=200,cap=4096,seed=5{rec}"
+        f"?index={idx}&shuffle=record&seed=3"
+    )
+    plain_uri = _uri(rec, idx)
+    base_env = {
+        "DMLC_TRACKER_URI": "127.0.0.1",
+        "DMLC_TRACKER_PORT": str(tr.port),
+    }
+    retiree, ep0 = _spawn_server(tmp_path, 0, base_env)
+    survivor, ep1 = _spawn_server(tmp_path, 1, base_env)
+    try:
+        c = DsServeBatches(
+            f"dsserve://{ep0},{ep1}/{slow_uri}", _spec(), mode="lease",
+        )
+        shas = {}
+        seen = []
+
+        def on_slot(shard, seq, payload):
+            shas.setdefault(shard, hashlib.sha256()).update(
+                payload.tobytes()
+            )
+            seen.append(shard)
+            if len(seen) == 3:  # early: both servers hold leases
+                retiree.send_signal(_signal.SIGTERM)
+
+        c.on_slot = on_slot
+        t0 = time.monotonic()
+        rows = sum(b.n_valid for b in c)
+        elapsed = time.monotonic() - t0
+        c.close()
+        # prompt on BOTH axes: the retiree exits zero without waiting
+        # out anything, and the drain never stalls on a TTL reclaim
+        assert retiree.wait(timeout=20) == 0
+        assert elapsed < 20.0, f"drain took {elapsed:.1f}s — TTL stall"
+    finally:
+        for p in (retiree, survivor):
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        tr.close()
+    summary = tr.shards.summary()
+    M = summary["n_shards"]
+    assert rows == N_ROWS
+    assert summary["completed"] == M
+    assert summary["duplicates"] == 0  # exactly-once across the retire
+    assert sorted(shas) == list(range(M))
+    for i in range(M):
+        p = fused.ell_batches(plain_uri, _spec(), part_index=i,
+                              num_parts=M)
+        _rows, sha, _slots = _drain_packed(p)
+        p.close()
+        assert shas[i].hexdigest() == sha, f"micro-shard {i} bytes differ"
+
+
+def test_inprocess_retire_mid_drain_exactly_once(corpus, tracker):
+    """``DsServeServer.retire()`` mid-drain: the retiring server stops
+    taking new shards, the sibling finishes the epoch, the ledger stays
+    exactly-once and the retiring flag is observable."""
+    rec, idx = corpus
+    s1 = DsServeServer(rank=101).start()
+    s2 = DsServeServer(rank=102).start()
+    try:
+        c = DsServeBatches(
+            f"dsserve://127.0.0.1:{s1.port},127.0.0.1:{s2.port}"
+            f"{_uri(rec, idx)}", _spec(), mode="lease",
+        )
+        seen = []
+
+        def on_slot(shard, seq, payload):
+            seen.append(shard)
+            if len(seen) == 2:
+                s1.retire()
+
+        c.on_slot = on_slot
+        rows = sum(b.n_valid for b in c)
+        c.close()
+        assert s1.retiring
+        assert s2.shards_streamed >= 1  # the sibling carried the epoch
+    finally:
+        s1.close()
+        s2.close()
+    summary = tracker.shards.summary()
+    assert rows == N_ROWS
+    assert summary["completed"] == summary["n_shards"]
+    assert summary["duplicates"] == 0
+
+
+def test_client_discovers_endpoints_from_file(
+    corpus, tracker, tmp_path, monkeypatch
+):
+    """DMLC_DSSERVE_FILE dynamic membership (the autoscale join path):
+    a client dialed at ONE endpoint picks the second out of the
+    endpoints file mid-stream and the drain stays exactly-once."""
+    rec, idx = corpus
+    s1 = DsServeServer(rank=101).start()
+    s2 = DsServeServer(rank=102).start()
+    eps = tmp_path / "endpoints.json"
+    eps.write_text(json.dumps({
+        "endpoints": [f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"],
+    }))
+    monkeypatch.setenv("DMLC_DSSERVE_FILE", str(eps))
+    try:
+        c = DsServeBatches(
+            f"dsserve://127.0.0.1:{s1.port}{_uri(rec, idx)}", _spec(),
+            mode="lease",
+        )
+        deadline = time.monotonic() + 5
+        while len(c.endpoints) < 2:
+            assert time.monotonic() < deadline, "discovery never added s2"
+            time.sleep(0.02)
+        rows = sum(b.n_valid for b in c)
+        c.close()
+    finally:
+        s1.close()
+        s2.close()
+    summary = tracker.shards.summary()
+    assert rows == N_ROWS
+    assert summary["completed"] == summary["n_shards"]
+    assert summary["duplicates"] == 0
