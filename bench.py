@@ -1649,6 +1649,211 @@ def _allreduce_recovery_bench() -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _shard_lease_drain_main(out: str, fileset: str) -> None:
+    """Worker mode (``bench.py --shard-lease-drain out fileset``): one
+    leaseholder of the ``tracker_kill_recovery`` drill — no rabit
+    rendezvous, just the dynamic-shard lease protocol against a
+    (possibly dying and relaunching) standalone journaled tracker.
+    Each granted shard is "trained" for one paced step, its
+    deterministic per-shard contribution written to a tmp file, and
+    the commit protocol is write-tmp -> done() -> rename-on-recorded:
+    the rename happens only when the ledger says this completion is
+    the one that counts, so a post-crash journal replay can never
+    double-commit a shard. Steps are paced
+    (BENCH_TRACKER_KILL_STEP_MS) so both runs are sleep-dominated and
+    the makespan ratio measures RECOVERY cost. Host-side only: numpy,
+    no jax."""
+    from dmlc_core_tpu.tracker.shardsvc import ShardLeaseClient
+
+    rank = int(os.environ.get("DMLC_TASK_ID", "0"))
+    step_ms = float(os.environ.get("BENCH_TRACKER_KILL_STEP_MS", "500"))
+    dim = int(os.environ.get("BENCH_TRACKER_KILL_DIM", "4096"))
+    t0 = time.perf_counter()
+    c = ShardLeaseClient(rank=rank)
+    committed = []
+    while True:
+        r = c.lease(0, fileset)
+        status = r.get("status")
+        if status == "done":
+            break
+        if status == "wait":
+            time.sleep(float(r.get("backoff", 0.05)))
+            continue
+        if status != "lease":
+            raise RuntimeError(
+                f"rank {rank}: unexpected lease reply {r}"
+            )
+        shard = int(r["shard"])
+        time.sleep(step_ms / 1000.0)
+        # deterministic per-shard contribution: the fold is a function
+        # of WHICH shards completed, never of which rank ran them or
+        # in what order — bit-identity across the crash is exact
+        part = np.sin(np.arange(dim, dtype=np.float64) * (shard + 1))
+        tmp = f"{out}.shard{shard}.tmp{os.getpid()}.npy"
+        np.save(tmp, part)
+        ack = c.done(0, shard, fileset)
+        if ack.get("status") == "recorded":
+            os.replace(tmp, f"{out}.shard{shard}.npy")
+            committed.append(shard)
+        else:
+            # duplicate: a peer already owns this shard's commit
+            os.unlink(tmp)
+    print(json.dumps({
+        "rank": rank,
+        "secs": round(time.perf_counter() - t0, 3),
+        "committed": sorted(committed),
+    }))
+
+
+def _tracker_kill_recovery_bench() -> dict:
+    """The ``tracker_kill_recovery`` config (ISSUE 17 acceptance): a
+    3-worker dynamic-shard job against a STANDALONE journaled tracker,
+    run clean and then with the tracker SIGKILLed mid-epoch and
+    relaunched on the SAME port with the SAME journal. Workers ride
+    ``connect_worker_retry`` through the outage; the relaunch replays
+    the journal with conservative lease expiry. Invariants: every
+    micro-shard committed exactly once across the crash, the folded
+    final model bit-identical to the clean run's, and the
+    kill-and-recover makespan within 2x clean."""
+    import shutil
+    import signal
+    import tempfile
+
+    n_workers = 3
+    oversplit = 3
+    n_shards = n_workers * oversplit
+    tmpdir = tempfile.mkdtemp(prefix="bench_trackerkill_")
+
+    def spawn_tracker(jdir, endpoint, port, port_end):
+        if os.path.exists(endpoint):
+            os.unlink(endpoint)
+        return subprocess.Popen(
+            [sys.executable, "-m", "dmlc_core_tpu.tracker.tracker",
+             "--host-ip", "127.0.0.1", "--port", str(port),
+             "--port-end", str(port_end),
+             "--num-workers", str(n_workers), "--journal", jdir,
+             "--endpoint-file", endpoint],
+            cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            # oversplit is a TRACKER-side knob (the ledger decides the
+            # shard count) — the workers' env alone would be ignored
+            env={**os.environ,
+                 "DMLC_SHARD_OVERSPLIT": str(oversplit)},
+        )
+
+    def await_endpoint(endpoint, proc, timeout=15.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if os.path.exists(endpoint):
+                with open(endpoint) as f:
+                    ep = json.load(f)
+                return int(ep["port"])
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    "standalone tracker died before publishing its "
+                    f"endpoint rc={proc.returncode}"
+                )
+            time.sleep(0.02)
+        raise RuntimeError("standalone tracker endpoint never published")
+
+    def run_drill(tag: str, kill_after: float) -> dict:
+        jdir = os.path.join(tmpdir, f"journal_{tag}")
+        endpoint = os.path.join(tmpdir, f"endpoint_{tag}.json")
+        out = os.path.join(tmpdir, f"fold_{tag}")
+        t0 = time.perf_counter()
+        tracker = spawn_tracker(jdir, endpoint, 9091, 9999)
+        port = await_endpoint(endpoint, tracker)
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "DMLC_TRACKER_URI": "127.0.0.1",
+            "DMLC_TRACKER_PORT": str(port),
+            "DMLC_SHARD_OVERSPLIT": str(oversplit),
+            "DMLC_TRACKER_RETRY_SECS": "30",
+        }
+        workers = [
+            subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "bench.py"),
+                 "--shard-lease-drain", out, f"bench://{tag}"],
+                env={**env, "DMLC_TASK_ID": str(r)},
+                stdout=subprocess.PIPE, text=True,
+            )
+            for r in range(n_workers)
+        ]
+        relaunches = 0
+        try:
+            if kill_after > 0:
+                time.sleep(kill_after)
+                done_before = sum(
+                    os.path.exists(f"{out}.shard{s}.npy")
+                    for s in range(n_shards)
+                )
+                assert done_before < n_shards, (
+                    "chaos kill fired after the epoch drained — "
+                    "nothing was left to recover"
+                )
+                tracker.send_signal(signal.SIGKILL)
+                tracker.wait()
+                # relaunch pinned to the SAME port with the SAME
+                # journal — exactly what TrackerSupervisor does
+                tracker = spawn_tracker(jdir, endpoint, port, port + 1)
+                await_endpoint(endpoint, tracker)
+                relaunches = 1
+            outs = [w.communicate()[0] for w in workers]
+            makespan = time.perf_counter() - t0
+            for w in workers:
+                assert w.returncode == 0, (
+                    f"{tag}: drill worker exited rc={w.returncode}"
+                )
+        finally:
+            tracker.terminate()
+            try:
+                tracker.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                tracker.kill()
+        committed: dict = {}
+        for o in outs:
+            rep = json.loads(o.strip().splitlines()[-1])
+            for s in rep["committed"]:
+                committed[int(s)] = committed.get(int(s), 0) + 1
+        model = np.sum(
+            np.stack([
+                np.load(f"{out}.shard{s}.npy") for s in range(n_shards)
+            ]),
+            axis=0,
+        )
+        return {
+            "makespan_secs": round(makespan, 3),
+            "relaunches": relaunches,
+            "committed": committed,
+            "model": model,
+        }
+
+    def exactly_once(drill: dict) -> bool:
+        return sorted(drill["committed"]) == list(
+            range(n_shards)
+        ) and all(v == 1 for v in drill["committed"].values())
+
+    try:
+        clean = run_drill("clean", 0.0)
+        chaos = run_drill("chaos", kill_after=1.5)
+        identical = bool(np.array_equal(chaos["model"], clean["model"]))
+        return {
+            "clean_makespan_secs": clean["makespan_secs"],
+            "recovery_makespan_secs": chaos["makespan_secs"],
+            "relaunches": chaos["relaunches"],
+            "exactly_once": exactly_once(clean) and exactly_once(chaos),
+            "identical": identical,
+            "recovery_makespan_ratio": round(
+                chaos["makespan_secs"]
+                / max(clean["makespan_secs"], 1e-9),
+                2,
+            ),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def ensure_rec_index() -> None:
     """Index file for the bench .rec (uniform frame stride → arithmetic
     offsets; format = IndexedRecordIOWriter's ``key<TAB>offset``)."""
@@ -2434,6 +2639,20 @@ def main() -> None:
             # regression, never a capability skip
             allreduce_recovery["failed"] = True
 
+    # control-plane death (ISSUE 17 acceptance): SIGKILL the journaled
+    # standalone tracker mid-epoch, relaunch on the same port with the
+    # same journal — every micro-shard exactly once, fold bit-identical
+    # to the clean run, makespan within 2x clean
+    try:
+        tracker_kill = _tracker_kill_recovery_bench()
+    except Exception as e:
+        tracker_kill = {"skipped": repr(e)}
+        if isinstance(e, (AssertionError, RuntimeError)):
+            # a lost/doubled shard commit or a wedged drill worker is a
+            # durability regression, never a capability skip (pure CPU
+            # sockets + numpy)
+            tracker_kill["failed"] = True
+
     # flight-recorder attribution of this very run (ISSUE 8): snapshot
     # the rings BEFORE the overhead probe (its calibration loop wraps
     # the main thread's ring), then measure the recorder's cost — the
@@ -2638,6 +2857,31 @@ def main() -> None:
                 f"{allreduce_recovery['recovery_makespan_ratio']}x the "
                 "clean run (invariant <= 2x)"
             )
+    # tracker_kill_recovery invariant (ISSUE 17): a tracker SIGKILL +
+    # journal replay must keep exactly-once shard commits, land on the
+    # clean run's fold bit-wise, and recover within 2x the clean
+    # makespan
+    if tracker_kill.get("failed"):
+        failures.append(
+            f"tracker_kill_recovery: {tracker_kill['skipped']}"
+        )
+    if "skipped" not in tracker_kill:
+        if not tracker_kill["exactly_once"]:
+            failures.append(
+                "tracker_kill_recovery: micro-shards not committed "
+                "exactly once across the tracker crash"
+            )
+        if not tracker_kill["identical"]:
+            failures.append(
+                "tracker_kill_recovery: folded model with tracker "
+                "kill + relaunch != clean run (bit-wise)"
+            )
+        if not (tracker_kill["recovery_makespan_ratio"] <= 2.0):
+            failures.append(
+                f"tracker_kill_recovery: kill-and-recover makespan "
+                f"{tracker_kill['recovery_makespan_ratio']}x the "
+                "clean run (invariant <= 2x)"
+            )
 
     print(
         json.dumps(
@@ -2715,6 +2959,13 @@ def main() -> None:
                 # makespan, final model bit-identical
                 "allreduce_recovery": allreduce_recovery,
                 "recovery_makespan_ratio": allreduce_recovery.get(
+                    "recovery_makespan_ratio"
+                ),
+                # control-plane death (ISSUE 17): SIGKILL the journaled
+                # tracker mid-epoch + same-port relaunch — exactly-once
+                # shard commits, bit-identical fold, within 2x clean
+                "tracker_kill_recovery": tracker_kill,
+                "tracker_recovery_makespan_ratio": tracker_kill.get(
                     "recovery_makespan_ratio"
                 ),
                 **_codec_summary(),
@@ -2845,5 +3096,9 @@ if __name__ == "__main__":
         # worker mode: one rank of the allreduce_recovery SGD drill,
         # numpy-only, no data generation
         _allreduce_sgd_main(sys.argv[2])
+    elif len(sys.argv) >= 4 and sys.argv[1] == "--shard-lease-drain":
+        # worker mode: one leaseholder of the tracker_kill_recovery
+        # drill, numpy-only, no rabit rendezvous
+        _shard_lease_drain_main(sys.argv[2], sys.argv[3])
     else:
         main()

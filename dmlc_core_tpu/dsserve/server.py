@@ -577,9 +577,15 @@ class DsServeServer:
             # client): rank-wide renews from sibling streams would
             # otherwise keep an abandoned lease alive forever, and
             # releasing a committed shard is a no-op
+            # a refused dial gets a SHORT reconnect budget (tracker
+            # mid-relaunch) — a dropped release costs a whole lease TTL
+            # of queue-time, but stream teardown must not hang out the
+            # full crash-recovery window per shard
             for shard in sorted(leased):
                 try:
-                    lease_client.release(epoch, shard, cfg.fileset)
+                    lease_client.release(
+                        epoch, shard, cfg.fileset, retry_secs=5.0
+                    )
                 except (OSError, ConnectionError):
                     pass
 
@@ -589,7 +595,9 @@ class DsServeServer:
         if now - state["last_renew"] >= state["ttl"] / 3.0:
             state["last_renew"] = now
             try:
-                lease_client.renew(epoch)
+                # short budget: the serve loop must keep streaming the
+                # in-hand shard through a tracker outage
+                lease_client.renew(epoch, retry_secs=2.0)
             except (OSError, ConnectionError):
                 pass  # next cadence retries; the TTL covers the gap
 

@@ -2461,7 +2461,10 @@ class DynamicShardSource(InputSplit):
             return
         self._last_renew = now
         try:
-            resp = self._client.renew(self.epoch)
+            # short reconnect budget: a renew rides the READ path, so
+            # it must not park the consumer for the full crash-recovery
+            # window — this cadence (below) is the real retry loop
+            resp = self._client.renew(self.epoch, retry_secs=2.0)
         except (OSError, ConnectionError):
             # transient: retry SOON (1s, not a full interval — two
             # hiccups in a row must not eat the whole TTL), but not on
@@ -2492,8 +2495,11 @@ class DynamicShardSource(InputSplit):
         """Hand an UNFINISHED lease back to the queue (close /
         mid-epoch restart). Best-effort on purpose — but not optional
         in spirit: a process whose rabit heartbeat outlives this source
-        would renew the abandoned lease forever, so only a tracker we
-        cannot reach at all is left to the TTL / supervisor reclaim."""
+        would renew the abandoned lease forever. A refused dial gets a
+        SHORT reconnect budget (a tracker mid-relaunch comes back in
+        seconds, and a dropped release otherwise waits out a TTL) —
+        only a tracker that stays unreachable past it is left to the
+        TTL / supervisor reclaim."""
         lease = self._lease
         self._lease = None
         if lease is None:
@@ -2501,7 +2507,7 @@ class DynamicShardSource(InputSplit):
         try:
             self._client.release(
                 int(lease.get("epoch", self.epoch)), int(lease["shard"]),
-                self._fileset,
+                self._fileset, retry_secs=5.0,
             )
         except (OSError, ConnectionError, ValueError, KeyError):
             pass

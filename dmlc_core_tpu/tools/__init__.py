@@ -641,6 +641,57 @@ def _cmd_ckpt(args) -> int:
     return 0
 
 
+def _cmd_journal(args) -> int:
+    """Operator surface for the tracker's control-plane journal
+    (tracker/journal.py): dump the snapshot and every WAL record (seq,
+    kind, CRC status), flag a torn tail, and say what a strict replay
+    would recover — the thing to run when a supervised tracker's
+    recovery looks wrong, BEFORE anyone deletes the directory."""
+    import json
+
+    from ..tracker import journal as _journal
+
+    dump = _journal.inspect_journal(args.dir)
+    if args.json:
+        print(json.dumps(dump, indent=2, default=str))
+        return 1 if (dump["crc_failures"] or
+                     not os.path.isdir(args.dir)) else 0
+    snap = dump["snapshot"]
+    if snap is None:
+        print("snapshot: none")
+    elif "error" in snap:
+        print(f"snapshot: CORRUPT ({snap['error']})")
+    else:
+        st = snap.get("state") or {}
+        shards = st.get("shards") or {}
+        print(
+            f"snapshot: seq={snap['seq']} "
+            f"fileset={shards.get('fileset')!r} "
+            f"epochs={len(shards.get('epochs') or {})} "
+            f"ranks={len(st.get('ranks') or {})}"
+        )
+    for r in dump["records"]:
+        status = "ok" if r["crc_ok"] else "CRC-FAIL"
+        print(
+            f"wal @{r['offset']:<8d} seq={r['seq']} "
+            f"kind={r['kind']} [{status}]"
+        )
+    if dump["torn_tail_at"] is not None:
+        print(
+            f"torn tail at byte {dump['torn_tail_at']} "
+            "(truncated on next writable open — an interrupted append, "
+            "not corruption)"
+        )
+    n_bad = dump["crc_failures"]
+    print(
+        f"{len(dump['records'])} WAL record(s), {n_bad} CRC failure(s)"
+    )
+    if n_bad:
+        print("strict replay would REFUSE this journal (CRC damage)")
+        return 1
+    return 0
+
+
 def _top_endpoint(raw: str) -> str:
     """Normalize the endpoint argument: full URL, host:port, or a bare
     port (loopback — the tracker binds 127.0.0.1)."""
@@ -1203,6 +1254,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ck.add_argument("--keep", type=int, default=3,
                     help="retention count for 'prune'")
     ck.set_defaults(fn=_cmd_ckpt)
+
+    jr = sub.add_parser(
+        "journal",
+        help="inspect a tracker control-plane journal directory",
+    )
+    jr.add_argument("action", choices=["inspect"])
+    jr.add_argument("dir", help="journal directory (--tracker-journal)")
+    jr.add_argument("--json", action="store_true", default=False,
+                    help="machine-readable dump")
+    jr.set_defaults(fn=_cmd_journal)
     return p
 
 

@@ -354,6 +354,8 @@ class AutoscaleController:
         cfg: AutoscaleConfig,
         actuator=None,
         clock=None,
+        journal=None,
+        recovered=None,
     ) -> None:
         import time as _time
 
@@ -368,6 +370,60 @@ class AutoscaleController:
         self._synced = False
         self.last: Optional[Dict[str, Any]] = None
         self.last_actual = cfg.min_workers
+        self._journal = journal
+        self._last_journaled: Optional[Dict[str, Any]] = None
+        if recovered:
+            self._restore(recovered)
+
+    def _restore(self, rec: Dict[str, Any]) -> None:
+        """Seed the control law's memory from a journaled ``autoscale``
+        record so a relaunched tracker neither double-spends the cost
+        ceiling (``cost_spent`` resumes where the dead tracker left it)
+        nor flaps (the dwell clock resumes mid-countdown instead of
+        resetting — a scale-up decided 20s before the crash still waits
+        only the REMAINING dwell, and never re-fires instantly)."""
+        now = self._clock()
+        st = self.state
+        st.target = max(
+            self.cfg.min_workers,
+            min(self.cfg.max_workers, int(rec.get("target", st.target))),
+        )
+        st.cost_spent = float(rec.get("cost_spent", 0.0))
+        st.last_direction = int(rec.get("last_direction", 0))
+        st.direction_changes = int(rec.get("direction_changes", 0))
+        # monotonic clocks do not survive a process restart: rebuild
+        # last_action_t from the journaled dwell-elapsed offset
+        dwell = rec.get("dwell_elapsed")
+        if dwell is not None:
+            st.last_action_t = now - max(0.0, float(dwell))
+        st.last_cost_t = now  # no cost accrues for the outage window
+        logger.info(
+            "autoscale state recovered: target=%d cost=%.1fws "
+            "dwell_elapsed=%s", st.target, st.cost_spent, dwell,
+        )
+
+    def _journal_state(self, now: float) -> None:
+        """Append an ``autoscale`` record when the recoverable slice of
+        controller state changed (every action; cost drift throttled by
+        the caller). Written inside the tick lock, BEFORE actuation —
+        a crash between journal and actuation recovers to the decided
+        target and the next tick re-converges the fleet."""
+        if self._journal is None:
+            return
+        st = self.state
+        rec = {
+            "target": st.target,
+            "cost_spent": round(st.cost_spent, 3),
+            "dwell_elapsed": (
+                round(now - st.last_action_t, 3)
+                if st.last_action_t is not None else None
+            ),
+            "last_direction": st.last_direction,
+            "direction_changes": st.direction_changes,
+        }
+        from . import journal as _jn  # local: avoid import cycle at module load
+        self._journal.append(_jn.K_AUTOSCALE, **rec)
+        self._last_journaled = rec
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "AutoscaleController":
@@ -430,6 +486,17 @@ class AutoscaleController:
             view = self.aggregator.windowed(self.cfg.window)
             action = decide(view, self.state, self.cfg, now)
             apply_action(self.state, action, now)
+            # journal every action; journal pure cost drift only past a
+            # coarse threshold so a long HOLD steady-state costs ~one
+            # record a minute, not one per tick
+            prev_cost = (
+                self._last_journaled["cost_spent"]
+                if self._last_journaled else 0.0
+            )
+            if action.kind != HOLD or self._last_journaled is None or (
+                self.state.cost_spent - prev_cost >= 60.0
+            ):
+                self._journal_state(now)
             _G_TARGET.set(self.state.target)
             _G_ACTUAL.set(actual)
             _G_COST.set(round(self.state.cost_spent, 3))

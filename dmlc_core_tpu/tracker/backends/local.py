@@ -27,7 +27,12 @@ from typing import Dict, List, Optional
 
 from .. import autoscale as _autoscale
 from .. import collective, shardsvc
-from ..supervisor import Supervisor, default_max_attempt
+from .. import tracker as _tracker
+from ..supervisor import (
+    RendezvousNeverCompleted,
+    Supervisor,
+    default_max_attempt,
+)
 from . import run_tracker_submit
 
 logger = logging.getLogger("dmlc_core_tpu.tracker")
@@ -278,6 +283,142 @@ class ElasticActuator:
         return self.tier.retire_worker(self.retire_grace) is not None
 
 
+class TrackerSupervisor:
+    """The durable control plane (``dmlc-submit --tracker-journal
+    DIR``): the tracker runs as a standalone ``python -m
+    dmlc_core_tpu.tracker.tracker`` subprocess journaling every
+    control-plane transition (shard grants/dones, rank assignments,
+    autoscale spend) to DIR, and this supervisor treats it like any
+    other task — ``watch()`` is polled from the submit loop, and an
+    unexpected death (crash, OOM kill, chaos SIGKILL) relaunches the
+    tracker on the SAME pinned port with the SAME journal directory.
+    The relaunched tracker replays snapshot+WAL, conservatively expires
+    every lease, and re-answers recover_rank; meanwhile the workers
+    ride ``connect_worker_retry`` through the outage, so the job
+    finishes exactly-once with no operator involvement
+    (docs/robustness.md)."""
+
+    def __init__(
+        self,
+        host_ip: str,
+        n_workers: int,
+        journal_dir: str,
+        port: int = 9091,
+        port_end: int = 9999,
+    ) -> None:
+        self.host_ip = host_ip
+        self.n_workers = n_workers
+        self.journal_dir = journal_dir
+        self._dir = tempfile.mkdtemp(prefix="dmlc-tracker-")
+        self.endpoint_file = os.path.join(self._dir, "tracker.json")
+        self._stopping = False
+        self.relaunches = 0
+        self.proc = self._spawn(port, port_end)
+        self.host, self.port = self._await_endpoint()
+        logger.info(
+            "supervised tracker serving %s:%d (journal %s)",
+            self.host, self.port, self.journal_dir,
+        )
+
+    def _spawn(self, port: int, port_end: int) -> subprocess.Popen:
+        try:
+            os.remove(self.endpoint_file)
+        except OSError:
+            pass
+        return subprocess.Popen([
+            sys.executable, "-m", "dmlc_core_tpu.tracker.tracker",
+            "--host-ip", self.host_ip,
+            "--port", str(port), "--port-end", str(port_end),
+            "--num-workers", str(self.n_workers),
+            "--journal", self.journal_dir,
+            "--endpoint-file", self.endpoint_file,
+        ])
+
+    def _await_endpoint(self, timeout: float = 15.0):
+        deadline = time.monotonic() + timeout
+        while not os.path.exists(self.endpoint_file):
+            if self.proc.poll() is not None or time.monotonic() > deadline:
+                raise RuntimeError(
+                    "supervised tracker failed to start (endpoint file "
+                    f"{self.endpoint_file} never appeared)"
+                )
+            time.sleep(0.05)
+        with open(self.endpoint_file) as f:
+            ep = json.load(f)
+        return str(ep["host"]), int(ep["port"])
+
+    def envs(self) -> Dict[str, object]:
+        return {
+            "DMLC_TRACKER_URI": self.host,
+            "DMLC_TRACKER_PORT": self.port,
+        }
+
+    def watch(self) -> bool:
+        """One supervision poll: True while the tracker serves (after
+        relaunching it if it died), False once it exited cleanly —
+        exit 0 means the rendezvous completed and the job is done."""
+        ret = self.proc.poll()
+        if ret is None:
+            return True
+        if self._stopping or ret == 0:
+            return False
+        self.relaunches += 1
+        logger.warning(
+            "tracker died (exit %s); relaunching on port %d from "
+            "journal %s (relaunch #%d)",
+            ret, self.port, self.journal_dir, self.relaunches,
+        )
+        # pinned range [port, port+1): the workers redial the address
+        # they already hold, so the reborn tracker MUST own it
+        self.proc = self._spawn(self.port, self.port + 1)
+        self.host, self.port = self._await_endpoint()
+        return True
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
+def _supervised_submit(args, launch_all, checks: List) -> None:
+    """The ``--tracker-journal`` form of the submit wait loop: tracker
+    in a supervised subprocess instead of in-process, so a control-plane
+    crash is a recoverable event rather than the job's end. Autoscale
+    runs in shadow mode here (the actuator lives in THIS process and
+    cannot be registered across the tracker's process boundary)."""
+    ip = _tracker.get_host_ip(args.host_ip or "auto")
+    sup = TrackerSupervisor(
+        ip, args.num_workers, args.tracker_journal,
+    )
+    envs = _tracker.worker_env(args.num_workers, 0)
+    envs.update(sup.envs())
+    try:
+        launch_all(args.num_workers, 0, envs)
+        while sup.watch():
+            time.sleep(0.1)
+            err = checks[0]() if checks else None
+            if err is not None:
+                if isinstance(err, RendezvousNeverCompleted):
+                    # every task exited 0 and a shard-only job has no
+                    # rendezvous to complete — the in-process path also
+                    # consults the ledger here, but across the process
+                    # boundary the exit codes are the verdict
+                    logger.info(
+                        "job finished without a rabit rendezvous "
+                        "(supervised tracker, all tasks exited 0)"
+                    )
+                    break
+                raise err
+    finally:
+        sup.stop()
+
+
 def make_launcher(
     cmd: List[str],
     nworker: int,
@@ -379,10 +520,15 @@ def submit(args) -> None:
         )
 
     try:
-        run_tracker_submit(
-            args, launch_all,
-            abort_check=lambda: checks[0]() if checks else None,
-        )
+        if (getattr(args, "tracker_journal", None)
+                and int(getattr(args, "num_servers", 0) or 0) == 0
+                and not args.dry_run):
+            _supervised_submit(args, launch_all, checks)
+        else:
+            run_tracker_submit(
+                args, launch_all,
+                abort_check=lambda: checks[0]() if checks else None,
+            )
     finally:
         _autoscale.set_actuator(None)
         if dsserve is not None:

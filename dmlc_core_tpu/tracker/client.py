@@ -30,7 +30,7 @@ from .protocol import (
     CMD_START,
     FramedSocket,
     connect_peer,
-    connect_worker,
+    connect_worker_retry,
     make_listener,
 )
 
@@ -77,10 +77,17 @@ class RabitWorker:
         self._ts_seq = 0  # newest time-series sample seq already shipped
 
     # -- tracker connection helpers -----------------------------------------
-    def _connect_tracker(self, cmd: str, rank: int, world: int) -> FramedSocket:
-        return connect_worker(
+    def _connect_tracker(
+        self, cmd: str, rank: int, world: int,
+        retry_secs: Optional[float] = None,
+    ) -> FramedSocket:
+        # every tracker RPC this worker makes — rendezvous, recover,
+        # log, heartbeat, shutdown — rides the reconnect-with-backoff
+        # dial, so a tracker crash+relaunch window (supervised restart
+        # from its journal) is survived instead of fatal
+        return connect_worker_retry(
             self.tracker_uri, self.tracker_port, rank, world, self.jobid, cmd,
-            trace_ctx=_tracing.rpc_context(),
+            trace_ctx=_tracing.rpc_context(), retry_secs=retry_secs,
         )
 
     # -- rendezvous ----------------------------------------------------------
@@ -281,7 +288,21 @@ class RabitWorker:
             data = json.dumps(metrics, separators=(",", ":"))
             shipped_seq = None
         with _tracing.span("dmlc:heartbeat", rank=self.rank):
-            fs = self._connect_tracker(CMD_METRICS, self.rank, -1)
+            try:
+                # short retry budget: a heartbeat runs on the training
+                # thread's cadence, so it rides out a brief tracker
+                # outage but never blocks an epoch on the full
+                # DMLC_TRACKER_RETRY_SECS reconnect window — a failed
+                # tick simply re-ships everything next tick
+                fs = self._connect_tracker(
+                    CMD_METRICS, self.rank, -1,
+                    retry_secs=_env_float("DMLC_HEARTBEAT_RETRY_SECS", 2.0),
+                )
+            except (ConnectionError, OSError, TimeoutError):
+                # tracker down: the sample stays un-shipped (seq NOT
+                # advanced) and the next tick retries — a heartbeat
+                # must never raise into the worker's training thread
+                return
             try:
                 fs.send_str(data)
                 # the tracker answers with its wall stamp the moment it
@@ -301,6 +322,9 @@ class RabitWorker:
                         )
                 except (ConnectionError, OSError, ValueError):
                     pass  # an old tracker replies nothing: no estimate
+            except (ConnectionError, OSError, TimeoutError):
+                # died mid-send: same contract — un-shipped, no raise
+                return
             finally:
                 fs.close()
         if shipped_seq is not None:

@@ -105,7 +105,7 @@ from ..telemetry import tracing as _tracing
 from ..utils.logging import Error
 from . import topology
 from .client import RabitWorker
-from .protocol import CMD_WATCH, FramedSocket, connect_worker
+from .protocol import CMD_WATCH, FramedSocket, connect_worker_retry
 
 __all__ = [
     "Collective",
@@ -1116,19 +1116,35 @@ class Collective:
             del self._early[k]
 
     # -- death watch (worker side) --------------------------------------------
-    def _start_watch(self) -> None:
-        if os.environ.get("DMLC_COLLECTIVE_WATCH", "1") in ("0", "false"):
-            return
+    def _dial_watch(self, retry_secs: Optional[float] = None) -> bool:
+        """(Re-)establish the persistent push connection; True on
+        success. ``retry_secs=0`` is the constructor's fail-fast probe
+        (no watch service → timeouts remain the backstop); the watch
+        loop re-dials with the full ``DMLC_TRACKER_RETRY_SECS`` budget
+        so a tracker relaunch gets its push channel back instead of
+        silently degrading every surviving worker to timeout discovery."""
         try:
-            self._watch_fs = connect_worker(
+            fs = connect_worker_retry(
                 self.worker.tracker_uri,
                 self.worker.tracker_port,
                 self.rank,
                 -1,
                 self.worker.jobid,
                 CMD_WATCH,
+                retry_secs=retry_secs,
             )
+            fs.sock.settimeout(None)
         except (OSError, ConnectionError):
+            return False
+        old, self._watch_fs = self._watch_fs, fs
+        if old is not None:
+            old.close()
+        return True
+
+    def _start_watch(self) -> None:
+        if os.environ.get("DMLC_COLLECTIVE_WATCH", "1") in ("0", "false"):
+            return
+        if not self._dial_watch(retry_secs=0):
             return  # no watch service: timeouts remain the backstop
         threading.Thread(
             target=self._watch_loop,
@@ -1137,19 +1153,25 @@ class Collective:
         ).start()
 
     def _watch_loop(self) -> None:
-        fs = self._watch_fs
-        if fs is None:
-            return
-        try:
-            fs.sock.settimeout(None)
-        except OSError:
-            return
         while True:
+            fs = self._watch_fs
+            if fs is None:
+                return
             try:
                 msg = fs.recv_str()
                 dead = int(json.loads(msg).get("dead_rank", -1))
             except (OSError, ConnectionError, ValueError):
-                return  # tracker gone / engine closed
+                # tracker gone (crash/relaunch) or engine closed: try
+                # to re-establish the push channel once the tracker is
+                # back; give up only when the reconnect budget is spent
+                if self._closed:
+                    return
+                try:
+                    if not self._dial_watch():
+                        return
+                except (Error, OSError, ConnectionError):
+                    return
+                continue
             sock = self.worker.links.get(dead)
             if sock is not None:
                 # half-close only: the app thread's blocked recv fails

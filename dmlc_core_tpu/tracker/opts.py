@@ -183,6 +183,17 @@ def get_opts(args: Optional[List[str]] = None):
              "with 'python -m dmlc_core_tpu.tools trace merge' "
              "(docs/observability.md).",
     )
+    # durable control plane (tracker/journal.py): tracker state journal
+    # + crash supervision (docs/robustness.md)
+    parser.add_argument(
+        "--tracker-journal", default=None, type=str, metavar="DIR",
+        help="Journal tracker control-plane state (shard ledger, rank "
+             "assignments, autoscale spend) to DIR and supervise the "
+             "tracker as a restartable subprocess: a crashed tracker is "
+             "relaunched on the same port, replays the journal, and "
+             "reconnecting workers resume exactly-once (exports "
+             "DMLC_TRACKER_JOURNAL; local backend only).",
+    )
     # tpu-pod backend (TPU-native, no reference analogue)
     parser.add_argument(
         "--tpu-name", default=None, type=str,

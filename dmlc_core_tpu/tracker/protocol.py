@@ -27,8 +27,10 @@ typo'd command can't silently become an unknown-cmd drop.
 
 from __future__ import annotations
 
+import os
 import socket
 import struct
+from typing import Optional
 
 MAGIC = 0xFF99
 
@@ -80,6 +82,8 @@ __all__ = [
     "MAGIC",
     "FramedSocket",
     "connect_worker",
+    "connect_worker_retry",
+    "default_tracker_retry_secs",
     "connect_peer",
     "make_listener",
     "bind_first_free",
@@ -183,6 +187,10 @@ def bind_first_free(
     is taken."""
     family = socket.getaddrinfo(host_ip, None)[0][0]
     sock = socket.socket(family, socket.SOCK_STREAM)
+    # a supervised tracker relaunches on the SAME pinned port moments
+    # after its predecessor was SIGKILLed: without SO_REUSEADDR the
+    # predecessor's TIME_WAIT remnants would make the rebind flaky
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
     for p in range(port, port_end):
         try:
             sock.bind((host_ip, p))
@@ -262,3 +270,68 @@ def connect_worker(
     except BaseException:
         sock.close()
         raise
+
+
+def default_tracker_retry_secs() -> float:
+    """``DMLC_TRACKER_RETRY_SECS`` (default 60): cumulative backoff
+    budget a client spends redialing an absent tracker before giving
+    up. Sized to cover a supervised tracker relaunch (SIGKILL
+    detection + restart + journal replay — docs/robustness.md); 0
+    disables reconnection (one attempt, fail fast)."""
+    try:
+        return max(
+            0.0, float(os.environ.get("DMLC_TRACKER_RETRY_SECS", "60"))
+        )
+    except ValueError:
+        return 60.0
+
+
+def connect_worker_retry(
+    host: str,
+    port: int,
+    rank: int,
+    world_size: int,
+    jobid: str,
+    cmd: str,
+    timeout: float = 30.0,
+    trace_ctx=None,
+    retry_secs: Optional[float] = None,
+) -> FramedSocket:
+    """``connect_worker`` that survives a tracker crash window: on a
+    transient dial/handshake failure (``io.retry.is_transient`` — the
+    refused/reset/timeout shapes a dead-or-restarting tracker
+    produces) it backs off with decorrelated jitter and redials until
+    ``retry_secs`` (default ``DMLC_TRACKER_RETRY_SECS``) of cumulative
+    backoff is spent, then re-raises the last error. The jitter is the
+    herd-breaker: a 100-worker fleet whose tracker just relaunched
+    redials spread over the backoff envelope instead of stampeding the
+    reborn listener in one synchronized wave. Every retry emits a
+    ``dmlc:tracker_reconnect`` trace instant, so a merged timeline
+    shows exactly which clients rode out which outage."""
+    from ..io.retry import RetryPolicy, is_transient
+    from ..telemetry import tracing as _tracing
+
+    budget = (
+        default_tracker_retry_secs() if retry_secs is None else retry_secs
+    )
+    policy = RetryPolicy(
+        max_attempts=1 << 30,  # the cumulative budget is the only cap
+        base_secs=0.05,
+        cap_secs=2.0,
+        budget_secs=max(0.0, budget),
+    )
+    attempt = 0
+    while True:
+        try:
+            return connect_worker(
+                host, port, rank, world_size, jobid, cmd, timeout, trace_ctx
+            )
+        except (OSError, ConnectionError) as e:
+            if budget <= 0 or not is_transient(e):
+                raise
+            attempt += 1
+            _tracing.instant(
+                "dmlc:tracker_reconnect",
+                cmd=cmd, rank=rank, attempt=attempt, error=type(e).__name__,
+            )
+            policy.pause(cause=e, what=f"tracker dial cmd={cmd}")

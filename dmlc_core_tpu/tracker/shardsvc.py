@@ -54,12 +54,15 @@ from typing import Callable, Dict, List, Optional
 
 from ..telemetry import default_registry as _default_registry
 from ..telemetry import tracing as _tracing
+from . import journal as _jn
+from ..io.retry import is_transient as _is_transient
 from .protocol import (
     CMD_SHARD_DONE,
     CMD_SHARD_LEASE,
     CMD_SHARD_RELEASE,
     CMD_SHARD_RENEW,
-    connect_worker,
+    connect_worker_retry,
+    default_tracker_retry_secs,
 )
 
 __all__ = [
@@ -273,11 +276,17 @@ class ShardService:
         oversplit: Optional[int] = None,
         ttl: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
+        journal=None,
     ) -> None:
         self.n_workers = n_workers
         self.oversplit = oversplit if oversplit else default_oversplit()
         self.ttl = ttl if ttl is not None else default_lease_ttl()
         self._clock = clock
+        #: optional tracker/journal.py Journal: ledger transitions that
+        #: matter for exactly-once are appended BEFORE the response is
+        #: sent, so a tracker relaunch replays to a state every client
+        #: ack is consistent with (docs/robustness.md)
+        self._journal = journal
         self._lock = threading.Lock()
         self._epochs: Dict[int, ShardLedger] = {}
         self._completed: Dict[int, bool] = {}  # aged-out epochs
@@ -407,6 +416,10 @@ class ShardService:
                         self._completed.clear()
                         self.n_shards = None
                         self._fileset = fileset
+                        if self._journal is not None:
+                            self._journal.append(
+                                _jn.K_DATASET_SWITCH, fileset=fileset
+                            )
                     else:
                         return {
                             "status": "error",
@@ -434,6 +447,12 @@ class ShardService:
             self._c_granted.inc()
             if lease.stolen:
                 self._c_stolen.inc()
+            if self._journal is not None:
+                self._journal.append(
+                    _jn.K_SHARD_GRANT, epoch=epoch, shard=lease.shard,
+                    rank=rank, fileset=self._fileset,
+                    n_shards=led.n_shards,
+                )
             self._update_queue_gauge()
             return {
                 "status": "lease",
@@ -488,6 +507,13 @@ class ShardService:
                 self._c_completed.inc()
                 if secs is not None:
                     self._h_shard_secs.observe(secs)
+                if self._journal is not None:
+                    # journaled before the ack: once a worker hears
+                    # "recorded", no tracker relaunch un-records it
+                    self._journal.append(
+                        _jn.K_SHARD_DONE, epoch=epoch, shard=shard,
+                        rank=rank,
+                    )
             else:
                 self._c_duplicate.inc()
             self._update_queue_gauge()
@@ -510,6 +536,11 @@ class ShardService:
             released = led.release(int(shard), rank)
             if released:
                 self._c_reclaimed.inc()
+                if self._journal is not None:
+                    self._journal.append(
+                        _jn.K_SHARD_RELEASE, epoch=epoch,
+                        shard=int(shard), rank=rank,
+                    )
             self._update_queue_gauge()
             return {"status": "ok", "released": int(released)}
 
@@ -563,6 +594,66 @@ class ShardService:
         under DMLC_TASK_ID, so task id IS the rank there)."""
         with self._lock:
             return self._task_rank.get(str(task_id), task_id)
+
+    # -- crash recovery (tracker/journal.py) ----------------------------------
+    def restore(self, state: Dict) -> Dict:
+        """Rebuild the ledgers from a journal fold (tracker restart with
+        ``--tracker-journal``). Completions are restored verbatim —
+        exactly-once survives the crash. Every granted-but-not-done
+        shard is **conservatively expired**: no lease is recreated (the
+        holder may be gone, and its connection certainly is), the shard
+        re-enters the queue FRONT, and its grant history lands in
+        ``reclaimed_from`` so the old holder's late ``record_done`` is
+        still honored instead of rejected as never-granted. Returns a
+        summary for the end-of-job report's ``recovery`` section."""
+        sh = (state or {}).get("shards") or {}
+        with self._lock:
+            self._fileset = sh.get("fileset")
+            if sh.get("n_shards"):
+                self.n_shards = int(sh["n_shards"])
+            restored_done = 0
+            expired = 0
+            for estr, ep in sorted(
+                (sh.get("epochs") or {}).items(), key=lambda kv: int(kv[0])
+            ):
+                epoch = int(estr)
+                n = int(self.n_shards or 0)
+                if n <= 0:
+                    continue  # grants imply a pinned geometry; skip noise
+                led = ShardLedger(epoch, n)
+                done = {
+                    int(s): int(r) for s, r in (ep.get("done") or {}).items()
+                }
+                outstanding = {
+                    int(s): int(r)
+                    for s, r in (ep.get("outstanding") or {}).items()
+                    if int(s) not in done
+                }
+                led.done = done
+                led.reclaimed_from.update(outstanding)
+                # queue: expired grants first (they have been waiting
+                # longest), then never-granted shards — no duplicates,
+                # or a shard could be double-leased after recovery
+                led.queue = deque(
+                    sorted(outstanding)
+                    + [
+                        s for s in range(n)
+                        if s not in done and s not in outstanding
+                    ]
+                )
+                led.granted = len(done) + len(outstanding)
+                led.reclaimed = len(outstanding)
+                self._epochs[epoch] = led
+                restored_done += len(done)
+                expired += len(outstanding)
+            self._update_queue_gauge()
+            return {
+                "epochs": len(self._epochs),
+                "completions_restored": restored_done,
+                "leases_expired": expired,
+                "fileset": self._fileset,
+                "n_shards": self.n_shards,
+            }
 
     # -- wire adapter ---------------------------------------------------------
     def handle(self, cmd: str, rank: int, payload: str) -> str:
@@ -727,23 +818,56 @@ class ShardLeaseClient:
         except ValueError:
             return 0
 
-    def _call(self, cmd: str, payload: Dict) -> Dict:
+    def _call(self, cmd: str, payload: Dict,
+              retry_secs: Optional[float] = None) -> Dict:
         # the piggybacked trace context binds the tracker's handler
         # span to whatever wait span encloses this call (the
         # shard_lease_wait stall gets its causal arrow on a merged
-        # timeline, docs/observability.md)
-        fs = connect_worker(
-            self.tracker_uri, self.tracker_port, self.rank, -1, "NULL",
-            cmd, self.timeout, trace_ctx=_tracing.rpc_context(),
+        # timeline, docs/observability.md). The retrying dial rides out
+        # a tracker crash+relaunch window (DMLC_TRACKER_RETRY_SECS):
+        # lease/renew/done are all safe to redial — the request frame
+        # is only sent on a COMPLETED handshake, and record_done is
+        # exactly-once on the tracker side either way
+        budget = (
+            default_tracker_retry_secs()
+            if retry_secs is None else float(retry_secs)
         )
-        try:
-            fs.send_str(json.dumps(payload, separators=(",", ":")))
-            resp = json.loads(fs.recv_str())
-            if not isinstance(resp, dict):
-                raise ConnectionError("malformed shard service response")
-            return resp
-        finally:
-            fs.close()
+        deadline = time.monotonic() + budget
+        delay = 0.05
+        while True:
+            remaining = max(0.0, deadline - time.monotonic())
+            fs = connect_worker_retry(
+                self.tracker_uri, self.tracker_port, self.rank, -1,
+                "NULL", cmd, self.timeout,
+                trace_ctx=_tracing.rpc_context(), retry_secs=remaining,
+            )
+            try:
+                fs.send_str(json.dumps(payload, separators=(",", ":")))
+                resp = json.loads(fs.recv_str())
+                if not isinstance(resp, dict):
+                    raise ConnectionError(
+                        "malformed shard service response"
+                    )
+                return resp
+            except (ConnectionError, OSError) as e:
+                # the dial is retried above, but the tracker can also
+                # die BETWEEN the completed handshake and the response
+                # (chaos SIGKILL mid-RPC): redial the WHOLE call within
+                # the same budget — safe because every shard RPC is
+                # idempotent tracker-side (record_done is exactly-once,
+                # a replayed lease/renew/release just re-answers)
+                if not _is_transient(e) or time.monotonic() >= deadline:
+                    raise
+                _tracing.instant(
+                    "dmlc:tracker_reconnect", cmd=cmd, rank=self.rank,
+                    attempt=-1, error=type(e).__name__,
+                )
+                time.sleep(
+                    min(delay, max(0.0, deadline - time.monotonic()))
+                )
+                delay = min(2.0, delay * 2)
+            finally:
+                fs.close()
 
     def lease(self, epoch: int, fileset: Optional[str] = None) -> Dict:
         if not self._explicit_rank:
@@ -755,8 +879,11 @@ class ShardLeaseClient:
             req["fileset"] = fileset
         return self._call(CMD_SHARD_LEASE, req)
 
-    def renew(self, epoch: int) -> Dict:
-        return self._call(CMD_SHARD_RENEW, {"epoch": epoch})
+    def renew(self, epoch: int,
+              retry_secs: Optional[float] = None) -> Dict:
+        return self._call(
+            CMD_SHARD_RENEW, {"epoch": epoch}, retry_secs=retry_secs
+        )
 
     def done(self, epoch: int, shard: int,
              fileset: Optional[str] = None) -> Dict:
@@ -766,8 +893,13 @@ class ShardLeaseClient:
         return self._call(CMD_SHARD_DONE, req)
 
     def release(self, epoch: int, shard: int,
-                fileset: Optional[str] = None) -> Dict:
+                fileset: Optional[str] = None,
+                retry_secs: Optional[float] = None) -> Dict:
+        """``retry_secs`` bounds the reconnect budget: teardown paths
+        pass a SHORT one — a release is worth a few redials (a dropped
+        release leaves the shard to the lease TTL), but a closing
+        process must not hang out the full crash-recovery window."""
         req: Dict = {"epoch": epoch, "shard": shard}
         if fileset:
             req["fileset"] = fileset
-        return self._call(CMD_SHARD_RELEASE, req)
+        return self._call(CMD_SHARD_RELEASE, req, retry_secs=retry_secs)

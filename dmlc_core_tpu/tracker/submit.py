@@ -54,6 +54,12 @@ def main(argv: Optional[List[str]] = None) -> None:
             )
         if getattr(args, "autoscale_dwell", 0.0):
             os.environ["DMLC_AUTOSCALE_DWELL"] = str(args.autoscale_dwell)
+    if getattr(args, "tracker_journal", None):
+        # the tracker process (in-process or supervised subprocess —
+        # backends/local.py) reads DMLC_TRACKER_JOURNAL when it builds
+        # its control-plane journal (tracker/journal.py)
+        os.makedirs(args.tracker_journal, exist_ok=True)
+        os.environ["DMLC_TRACKER_JOURNAL"] = args.tracker_journal
     if getattr(args, "trace_dir", None):
         # one env export covers every process of the job: the tracker
         # (this process), workers and the block-cache daemon inherit

@@ -26,6 +26,7 @@ from ..telemetry import timeseries as _timeseries
 from ..telemetry import tracing as _tracing
 from . import autoscale as _autoscale
 from . import collective as _collective
+from . import journal as _journal
 from . import shardsvc as _shardsvc
 from .protocol import (
     CMD_METRICS,
@@ -316,6 +317,7 @@ class RabitTracker:
         port: int = 9091,
         port_end: int = 9999,
         client_timeout: float = 60.0,
+        journal_dir: Optional[str] = None,
     ) -> None:
         #: per-socket recv/send deadline: a stalling (slow-loris) client
         #: must not wedge the single-threaded accept loop. Timeouts raise
@@ -352,13 +354,56 @@ class RabitTracker:
                 _timeseries.TRACKER_RANK, [s]
             )
         )
+        # durable control plane (journal.py, docs/robustness.md): with
+        # --tracker-journal / DMLC_TRACKER_JOURNAL the ledger
+        # transitions, rank assignments and autoscale budget are WAL'd,
+        # and a relaunch on this directory replays them — leases expire
+        # conservatively, completions and ranks survive, exactly-once
+        # holds across the crash
+        if journal_dir is None:
+            journal_dir = os.environ.get("DMLC_TRACKER_JOURNAL") or None
+        self._journal: Optional[_journal.Journal] = None
+        self._recovered_ranks: Dict[str, int] = {}
+        self._recovered_autoscale: Optional[Dict[str, object]] = None
+        self.recovery_summary: Optional[Dict[str, object]] = None
+        #: bumped per tracker generation: journal records distinguish
+        #: pre-crash from post-relaunch assignments by this number
+        self._topo_epoch = 1
+        if journal_dir:
+            self._journal = _journal.Journal(journal_dir)
         # dynamic shard service (shardsvc.py, docs/sharding.md): a
         # leased micro-shard work queue riding this tracker's socket —
         # idle until the first cmd=shard_lease arrives, so static jobs
         # pay nothing. Registered process-globally so the supervisor's
         # failure hook can reclaim a dead task's leases immediately.
-        self.shards = _shardsvc.ShardService(n_workers)
+        self.shards = _shardsvc.ShardService(n_workers, journal=self._journal)
         _shardsvc.set_active(self.shards)
+        if self._journal is not None and self._journal.recovered:
+            state = self._journal.state
+            shard_summary = self.shards.restore(state)
+            self._recovered_ranks = {
+                j: int(r["rank"]) for j, r in (state.get("ranks") or {}).items()
+            }
+            for jobid, rank in self._recovered_ranks.items():
+                self.shards.note_task_rank(jobid, rank)
+            self._recovered_autoscale = state.get("autoscale")
+            self._topo_epoch = 1 + max(
+                (
+                    int(r.get("topo_epoch", 0))
+                    for r in (state.get("ranks") or {}).values()
+                ),
+                default=0,
+            )
+            self.recovery_summary = {
+                "journal_dir": journal_dir,
+                **self._journal.recovery_info,
+                **shard_summary,
+                "ranks_recovered": len(self._recovered_ranks),
+            }
+            logger.info(
+                "@tracker recovered from journal %s: %s",
+                journal_dir, self.recovery_summary,
+            )
         # collective peer-death watch (collective.py, docs/collectives.md):
         # workers holding a cmd=watch connection learn of a supervisor-
         # reported task failure the instant the supervisor does.
@@ -468,7 +513,11 @@ class RabitTracker:
     def _accept_workers(self, n_workers: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
         wait_conn: Dict[int, WorkerEntry] = {}
-        job_map: Dict[str, int] = {}
+        # a journal-recovered tracker re-seeds the jobid→rank memo so a
+        # surviving worker's cmd=recover (and a relaunched worker's
+        # memo'd cmd=start) is re-answered with the rank it held before
+        # the crash — peer links re-broker from scratch
+        job_map: Dict[str, int] = dict(self._recovered_ranks)
         pending: List[WorkerEntry] = []
         todo_nodes: List[int] = []
         deferred_shutdown: List[WorkerEntry] = []
@@ -476,6 +525,15 @@ class RabitTracker:
         started: Set[int] = set()      # ranks whose assignment COMPLETED
         tree_map = parent_map = ring_map = None
         broker: Optional[_BrokerPool] = None
+        if job_map:
+            # ranks existed before the crash, so the topology must too:
+            # without it, the first post-relaunch cmd=recover would be
+            # rejected as "recover before any worker started"
+            tree_map, parent_map, ring_map = get_link_map(n_workers)
+            todo_nodes = list(range(n_workers))
+            broker = _BrokerPool(
+                self._events, wait_conn, tree_map, parent_map, ring_map,
+            )
 
         def check_proto(ok: bool, why: str) -> None:
             if not ok:
@@ -554,6 +612,12 @@ class RabitTracker:
                     # death watch pushes rank-keyed notices the same way)
                     self.shards.note_task_rank(entry.jobid, rank_done)
                     self.watch.note_task_rank(entry.jobid, rank_done)
+                    if self._journal is not None:
+                        self._journal.append(
+                            _journal.K_RANK_ASSIGN, jobid=entry.jobid,
+                            rank=rank_done, world=n_workers,
+                            topo_epoch=self._topo_epoch,
+                        )
                 logger.debug(
                     "%s from %s; assigned rank %d",
                     entry.cmd, entry.host, rank_done,
@@ -752,7 +816,11 @@ class RabitTracker:
         shard_summary = (
             self.shards.summary() if self.shards.n_shards is not None else None
         )
-        if self.metrics.updates == 0 and shard_summary is None:
+        if (
+            self.metrics.updates == 0
+            and shard_summary is None
+            and self._journal is None
+        ):
             return
         import json
 
@@ -762,6 +830,18 @@ class RabitTracker:
             )
             if shard_summary is not None:
                 self.metrics_report["shards"] = shard_summary
+            if self._journal is not None:
+                # one-line recovery summary (tools journal inspect has
+                # the full dump): did this tracker generation replay a
+                # journal, and what did the replay restore?
+                self.metrics_report["recovery"] = (
+                    dict(self.recovery_summary)
+                    if self.recovery_summary is not None
+                    else {"journal_dir": self._journal.dir, "recovered": False}
+                )
+                self.metrics_report["recovery"]["journal_seq"] = (
+                    self._journal.seq
+                )
         except Exception:
             # a failed report must never kill the state thread at the
             # finish line (heartbeat payloads are sanitized, but the
@@ -817,7 +897,9 @@ class RabitTracker:
                     "off): controller will hold on no_signal"
                 )
             self.autoscaler = _autoscale.AutoscaleController(
-                self.metrics, as_cfg
+                self.metrics, as_cfg,
+                journal=self._journal,
+                recovered=self._recovered_autoscale,
             ).start()
             self.metrics.extra_sections["autoscale"] = self.autoscaler.status
         self._accept_thread = threading.Thread(
@@ -867,6 +949,8 @@ class RabitTracker:
         if _collective.active_watch() is self.watch:
             _collective.set_active_watch(None)
         self.watch.close()
+        if self._journal is not None:
+            self._journal.close()
 
 
 class PSTracker:
@@ -1004,3 +1088,55 @@ def submit(
                 err = abort_check()
                 if err is not None:
                     raise err
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Standalone tracker process (``python -m
+    dmlc_core_tpu.tracker.tracker``): the supervised form
+    backends/local.py launches when ``--tracker-journal`` is set. The
+    tracker runs OUTSIDE the submit process, so a crash (or a chaos
+    SIGKILL) takes down only the control plane; the supervisor
+    relaunches this entry on the SAME pinned port with the SAME journal
+    directory, the journal replay restores the ledger/ranks/budget, and
+    workers ride ``connect_worker_retry`` through the outage. The
+    chosen endpoint is published via ``--endpoint-file`` (atomic
+    rename), and the process serves until SIGTERM or job completion."""
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(description="standalone rabit tracker")
+    p.add_argument("--host-ip", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9091)
+    p.add_argument("--port-end", type=int, default=9999)
+    p.add_argument("--num-workers", type=int, required=True)
+    p.add_argument("--journal", default=None,
+                   help="journal directory (crash recovery state)")
+    p.add_argument("--endpoint-file", default=None,
+                   help="publish {host, port} JSON here once listening")
+    args = p.parse_args(argv)
+    tracker = RabitTracker(
+        args.host_ip, args.num_workers,
+        port=args.port, port_end=args.port_end, journal_dir=args.journal,
+    )
+    tracker.start(args.num_workers)
+    if args.endpoint_file:
+        tmp = f"{args.endpoint_file}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"host": tracker.host_ip, "port": tracker.port}, f)
+        os.replace(tmp, args.endpoint_file)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_a: stop.set())
+    try:
+        # serve until told to stop or until the rendezvous state thread
+        # finished a complete job (shard-only jobs have no rendezvous
+        # completion — the launcher SIGTERMs this process at job end)
+        while not stop.is_set() and tracker.alive():
+            stop.wait(0.2)
+    except KeyboardInterrupt:
+        pass
+    tracker.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

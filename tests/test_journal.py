@@ -1,0 +1,521 @@
+"""Durable tracker control plane (tracker/journal.py + recovery wiring,
+docs/robustness.md): WAL framing damage shapes (torn tail truncated,
+CRC corruption refused), snapshot+WAL replay equivalence, the shard
+service's conservative lease expiry on restore, rank re-answering, the
+universal reconnect dial (storm of clients riding out an outage), the
+heartbeat's never-raise contract while the tracker is down, and the
+chaos drill — a standalone tracker SIGKILLed mid-epoch, relaunched on
+the same port from its journal, every micro-shard exactly-once."""
+
+import copy
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlc_core_tpu.tracker import journal as jn
+from dmlc_core_tpu.tracker.client import RabitWorker
+from dmlc_core_tpu.tracker.protocol import (
+    MAGIC,
+    FramedSocket,
+    connect_worker_retry,
+    make_listener,
+)
+from dmlc_core_tpu.tracker.shardsvc import ShardLeaseClient, ShardService
+from dmlc_core_tpu.tracker.tracker import RabitTracker
+
+
+# -- journal unit: append / replay / damage ------------------------------------
+
+def _sample_records():
+    return [
+        (jn.K_DATASET_SWITCH, {"fileset": "fs://a"}),
+        (jn.K_SHARD_GRANT,
+         {"epoch": 0, "shard": 0, "rank": 1, "fileset": "fs://a",
+          "n_shards": 4}),
+        (jn.K_SHARD_GRANT,
+         {"epoch": 0, "shard": 1, "rank": 2, "fileset": "fs://a",
+          "n_shards": 4}),
+        (jn.K_SHARD_DONE, {"epoch": 0, "shard": 0, "rank": 1}),
+        (jn.K_SHARD_RELEASE, {"epoch": 0, "shard": 1, "rank": 2}),
+        (jn.K_RANK_ASSIGN,
+         {"jobid": "job0", "rank": 0, "world": 2, "topo_epoch": 1}),
+        (jn.K_AUTOSCALE,
+         {"target": 3, "cost_spent": 42.5, "dwell_elapsed": 1.5,
+          "last_direction": 1, "direction_changes": 1}),
+    ]
+
+
+def test_replay_equals_live_fold(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    assert not j.recovered
+    for kind, fields in _sample_records():
+        j.append(kind, **fields)
+    live = copy.deepcopy(j.state)
+    j.close()
+    state, last_seq, info = jn.read_journal(d)
+    assert state == live
+    assert last_seq == len(_sample_records())
+    assert info["torn_tail_at"] is None
+    # the ledger facts themselves
+    ep = state["shards"]["epochs"]["0"]
+    assert ep["done"] == {"0": 1}
+    # release keeps the shard outstanding: grant history must outlive
+    # it so a post-recovery late done is honored, not "never granted"
+    assert ep["outstanding"] == {"1": 2}
+    assert state["ranks"]["job0"]["rank"] == 0
+    assert state["autoscale"]["cost_spent"] == 42.5
+
+
+def test_double_replay_byte_identical(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    for kind, fields in _sample_records():
+        j.append(kind, **fields)
+    j.close()
+    one = jn.read_journal(d)
+    two = jn.read_journal(d)
+    assert json.dumps(one, sort_keys=True) == json.dumps(two, sort_keys=True)
+
+
+def test_torn_tail_truncated_mid_record(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    for kind, fields in _sample_records():
+        j.append(kind, **fields)
+    live = copy.deepcopy(j.state)
+    j.close()
+    wal = os.path.join(d, jn.WAL_NAME)
+    clean_size = os.path.getsize(wal)
+    # a crash mid-append: full header promising more payload than exists
+    with open(wal, "ab") as f:
+        f.write(struct.pack("<II", 0xDEADBEEF, 1 << 10))
+        f.write(b"partial")
+    state, last_seq, info = jn.read_journal(d)
+    assert info["torn_tail_at"] == clean_size
+    assert state == live  # everything before the tear survives
+    # a writable open truncates the tear in place and appends cleanly
+    j2 = jn.Journal(d)
+    assert j2.recovered
+    assert os.path.getsize(wal) == clean_size
+    j2.append(jn.K_SHARD_DONE, epoch=0, shard=1, rank=2)
+    j2.close()
+    state3, _, info3 = jn.read_journal(d)
+    assert info3["torn_tail_at"] is None
+    assert state3["shards"]["epochs"]["0"]["done"] == {"0": 1, "1": 2}
+    # header-only tear (shorter than the frame header) also flagged
+    with open(wal, "ab") as f:
+        f.write(b"\x01\x02")
+    _, _, info4 = jn.read_journal(d)
+    assert info4["torn_tail_at"] == os.path.getsize(wal) - 2
+
+
+def test_crc_corruption_refused_but_inspectable(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    for kind, fields in _sample_records():
+        j.append(kind, **fields)
+    j.close()
+    wal = os.path.join(d, jn.WAL_NAME)
+    raw = bytearray(open(wal, "rb").read())
+    raw[12] ^= 0xFF  # inside the first record's payload
+    open(wal, "wb").write(bytes(raw))
+    with pytest.raises(jn.JournalError):
+        jn.read_journal(d)
+    with pytest.raises(jn.JournalError):
+        jn.Journal(d)  # the writable open is strict too
+    dump = jn.inspect_journal(d)  # lenient: operators still get a look
+    assert dump["crc_failures"] == 1
+    assert dump["records"][0]["crc_ok"] is False
+    assert all(r["crc_ok"] for r in dump["records"][1:])
+
+
+def test_snapshot_compacts_wal_and_replays(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d, snapshot_every=3)  # auto-snapshot mid-stream
+    for kind, fields in _sample_records():
+        j.append(kind, **fields)
+    live = copy.deepcopy(j.state)
+    seq = j.seq
+    j.close()
+    assert os.path.exists(os.path.join(d, jn.SNAPSHOT_NAME))
+    # WAL only holds records SINCE the last snapshot
+    records, torn = jn._scan_wal(os.path.join(d, jn.WAL_NAME), strict=True)
+    assert torn is None and len(records) < len(_sample_records())
+    state, last_seq, info = jn.read_journal(d)
+    assert state == live and last_seq == seq
+    assert info["snapshot_seq"] > 0
+
+
+def test_corrupt_snapshot_refused(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    j.append(jn.K_DATASET_SWITCH, fileset="fs://a")
+    j.snapshot()
+    j.close()
+    snap = os.path.join(d, jn.SNAPSHOT_NAME)
+    open(snap, "w").write("{not json")
+    with pytest.raises(jn.JournalError):
+        jn.read_journal(d)
+    assert "error" in jn.inspect_journal(d)["snapshot"]
+
+
+def test_sync_policy_env(monkeypatch):
+    monkeypatch.delenv("DMLC_TRACKER_JOURNAL_SYNC", raising=False)
+    assert jn.default_sync_policy() == "always"
+    monkeypatch.setenv("DMLC_TRACKER_JOURNAL_SYNC", "interval")
+    assert jn.default_sync_policy() == "interval"
+    monkeypatch.setenv("DMLC_TRACKER_JOURNAL_SYNC", "bogus")
+    assert jn.default_sync_policy() == "always"
+
+
+def test_unknown_record_kind_skipped(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    j.append("kind_from_the_future", payload="whatever")
+    j.append(jn.K_DATASET_SWITCH, fileset="fs://a")
+    j.close()
+    state, last_seq, _ = jn.read_journal(d)
+    assert last_seq == 2
+    assert state["shards"]["fileset"] == "fs://a"
+
+
+# -- shard service restore: conservative expiry --------------------------------
+
+def test_service_restore_conservative_expiry(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    svc = ShardService(n_workers=2, oversplit=2, journal=j)  # 4 shards
+    r = svc.lease(rank=0, epoch=0, fileset="fs://x")
+    assert r["status"] == "lease"
+    first = r["shard"]
+    assert svc.done(0, 0, first, "fs://x")["status"] == "recorded"
+    r2 = svc.lease(rank=1, epoch=0, fileset="fs://x")
+    held = r2["shard"]
+    j.close()
+
+    # "relaunch": a fresh journal + service seeded from the replay
+    j2 = jn.Journal(d)
+    assert j2.recovered
+    svc2 = ShardService(n_workers=2, oversplit=2, journal=j2)
+    summary = svc2.restore(j2.state)
+    assert summary["completions_restored"] == 1
+    assert summary["leases_expired"] == 1  # held-but-not-done expired
+    # the committed shard stays committed: duplicate, not re-granted
+    assert svc2.done(0, 0, first, "fs://x")["status"] == "duplicate"
+    # a LATE done for the shard leased before the crash is honored —
+    # the client committed its output while the tracker was dead
+    assert svc2.done(1, 0, held, "fs://x")["status"] == "recorded"
+    # drain the rest: every shard granted exactly once overall
+    seen = set()
+    while True:
+        g = svc2.lease(rank=0, epoch=0, fileset="fs://x")
+        if g["status"] != "lease":
+            break
+        assert g["shard"] not in (first, held)
+        assert g["shard"] not in seen
+        seen.add(g["shard"])
+        svc2.done(0, 0, g["shard"], "fs://x")
+    assert len(seen) == 2  # 4 shards total - first - held
+    assert svc2.all_complete()
+
+
+def test_tracker_seeds_rank_memo_from_journal(tmp_path):
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    j.append(jn.K_RANK_ASSIGN, jobid="7", rank=1, world=2, topo_epoch=1)
+    j.append(jn.K_RANK_ASSIGN, jobid="9", rank=0, world=2, topo_epoch=1)
+    j.close()
+    t = RabitTracker("127.0.0.1", 2, journal_dir=d)
+    try:
+        assert t._recovered_ranks == {"7": 1, "9": 0}
+        assert t._topo_epoch == 2  # next generation
+        assert t.recovery_summary["ranks_recovered"] == 2
+    finally:
+        t.close()
+
+
+# -- universal reconnect dial --------------------------------------------------
+
+class _LateTracker(threading.Thread):
+    """A tracker-shaped listener that starts accepting after a delay —
+    the crash+relaunch window a reconnecting client rides out."""
+
+    def __init__(self, port: int, delay: float, n_accepts: int) -> None:
+        super().__init__(daemon=True)
+        self.port = port
+        self.delay = delay
+        self.n_accepts = n_accepts
+        self.accepted = 0
+
+    def run(self) -> None:
+        time.sleep(self.delay)
+        srv = make_listener("127.0.0.1", self.port, backlog=64)
+        try:
+            for _ in range(self.n_accepts):
+                conn, _ = srv.accept()
+                fs = FramedSocket(conn)
+                assert fs.recv_int() == MAGIC
+                fs.send_int(MAGIC)
+                fs.recv_int()  # rank
+                fs.recv_int()  # world
+                fs.recv_str()  # jobid
+                fs.recv_str()  # cmd
+                self.accepted += 1
+                fs.close()
+        finally:
+            srv.close()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_connect_worker_retry_rides_outage():
+    port = _free_port()
+    srv = _LateTracker(port, delay=0.6, n_accepts=1)
+    srv.start()
+    fs = connect_worker_retry(
+        "127.0.0.1", port, 0, -1, "job", "print", retry_secs=15.0
+    )
+    fs.close()
+    srv.join(timeout=10)
+    assert srv.accepted == 1
+
+
+def test_connect_worker_retry_zero_budget_fails_fast():
+    port = _free_port()  # nothing listening
+    t0 = time.monotonic()
+    with pytest.raises((ConnectionError, OSError)):
+        connect_worker_retry(
+            "127.0.0.1", port, 0, -1, "job", "print", retry_secs=0
+        )
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_reconnect_storm_all_clients_within_budget():
+    """8 clients dialing a down tracker: every one re-leases once it
+    relaunches, inside the retry budget, jittered (no client needs the
+    whole budget, none gives up)."""
+    n = 8
+    port = _free_port()
+    srv = _LateTracker(port, delay=0.8, n_accepts=n)
+    srv.start()
+    errors = []
+
+    def client(i: int) -> None:
+        try:
+            fs = connect_worker_retry(
+                "127.0.0.1", port, i, -1, f"job{i}", "print",
+                retry_secs=20.0,
+            )
+            fs.close()
+        except Exception as e:  # noqa: BLE001 - collected for the assert
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(n)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors
+    srv.join(timeout=10)
+    assert srv.accepted == n
+    assert time.monotonic() - t0 < 20.0
+
+
+# -- satellite: heartbeat never raises while the tracker is down ---------------
+
+def test_heartbeat_tracker_down_never_raises(monkeypatch):
+    monkeypatch.setenv("DMLC_HEARTBEAT_RETRY_SECS", "0.2")
+    w = RabitWorker("127.0.0.1", _free_port(), jobid="0")
+    w.rank = 0  # heartbeat requires a completed start(); fake the rank
+    w._ts_seq = 7
+    t0 = time.monotonic()
+    w.heartbeat({"counters": {"x": 1}})  # must return, not raise
+    assert time.monotonic() - t0 < 5.0
+    # the sample stays un-shipped: seq NOT advanced, next tick re-ships
+    assert w._ts_seq == 7
+
+
+def test_heartbeat_reships_after_tracker_returns(monkeypatch):
+    """The tick after an outage ships successfully (regression pin for
+    the mark-unshipped-retry-next-tick contract)."""
+    monkeypatch.setenv("DMLC_HEARTBEAT_RETRY_SECS", "0.2")
+    t = RabitTracker("127.0.0.1", 1)
+    t.start(1)
+    try:
+        w = RabitWorker("127.0.0.1", t.port, jobid="0")
+        w.rank = 0
+        w.heartbeat({"counters": {"x": 1}})
+        deadline = time.monotonic() + 5.0
+        while 0 not in t.metrics.per_rank() and (
+            time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        assert 0 in t.metrics.per_rank()
+    finally:
+        t.close()
+
+
+# -- the chaos drill -----------------------------------------------------------
+
+def _spawn_tracker(journal_dir, ep_file, n_workers, port, port_end):
+    try:
+        os.remove(ep_file)
+    except OSError:
+        pass
+    return subprocess.Popen([
+        sys.executable, "-m", "dmlc_core_tpu.tracker.tracker",
+        "--host-ip", "127.0.0.1", "--port", str(port),
+        "--port-end", str(port_end), "--num-workers", str(n_workers),
+        "--journal", journal_dir, "--endpoint-file", ep_file,
+    ])
+
+
+def _await_endpoint(proc, ep_file, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(ep_file):
+        assert proc.poll() is None, f"tracker died rc={proc.poll()}"
+        assert time.monotonic() < deadline, "endpoint file never appeared"
+        time.sleep(0.05)
+    ep = json.load(open(ep_file))
+    return ep["host"], int(ep["port"])
+
+
+def test_tracker_kill_recovery_exactly_once(tmp_path, monkeypatch):
+    """The acceptance drill in miniature: 3 lease-holding workers, the
+    tracker SIGKILLed mid-epoch and relaunched on the same port from
+    its journal; every micro-shard is committed exactly once and the
+    fold of per-shard outputs is identical to a clean run's."""
+    monkeypatch.setenv("DMLC_SHARD_OVERSPLIT", "3")
+    monkeypatch.setenv("DMLC_TRACKER_RETRY_SECS", "30")
+    fileset = "fs://chaos"
+    n_workers, n_shards = 3, 9
+
+    def run_drill(tag: str, kill_after: int):
+        """Drain one epoch; SIGKILL+relaunch the tracker after
+        ``kill_after`` commits (0 = clean run). Returns {shard: fold}
+        and the commit counts per shard."""
+        jdir = str(tmp_path / f"journal-{tag}")
+        ep_file = str(tmp_path / f"ep-{tag}.json")
+        port = _free_port()
+        proc = _spawn_tracker(jdir, ep_file, n_workers, port, port + 50)
+        host, bound = _await_endpoint(proc, ep_file)
+        commits: dict = {}
+        lock = threading.Lock()
+        killed = threading.Event()
+        errors: list = []
+
+        def worker(rank: int) -> None:
+            try:
+                c = ShardLeaseClient(host, bound, rank=rank)
+                backoffs = 0
+                while True:
+                    r = c.lease(0, fileset)
+                    if r["status"] == "done":
+                        return  # epoch fully drained by the fleet
+                    if r["status"] == "wait":
+                        backoffs += 1
+                        if backoffs > 200:
+                            raise RuntimeError("livelocked on wait")
+                        time.sleep(min(0.1, r.get("backoff", 0.05)))
+                        continue
+                    if r["status"] != "lease":
+                        raise RuntimeError(f"lease -> {r}")
+                    backoffs = 0
+                    shard = int(r["shard"])
+                    # deterministic per-shard contribution, then commit
+                    value = shard * shard + 1
+                    d = c.done(0, shard, fileset)
+                    if d["status"] == "recorded":
+                        with lock:
+                            commits[shard] = commits.get(shard, 0) + 1
+                            commits.setdefault("values", {})[shard] = value
+                            n_done = sum(
+                                1 for k in commits if isinstance(k, int)
+                            )
+                        if (kill_after and n_done == kill_after
+                                and not killed.is_set()):
+                            killed.set()  # exactly one killer
+                            proc.send_signal(signal.SIGKILL)
+                            proc.wait()
+                            p2 = _spawn_tracker(
+                                jdir, ep_file, n_workers, bound, bound + 1
+                            )
+                            procs.append(p2)
+                            _await_endpoint(p2, ep_file)
+                    if d.get("epoch_complete"):
+                        return
+            except Exception as e:  # noqa: BLE001 - surfaced via assert
+                errors.append((rank, e))
+
+        procs = [proc]
+        threads = [
+            threading.Thread(target=worker, args=(r,))
+            for r in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+                p.wait(timeout=10)
+        assert not errors, errors
+        assert not any(t.is_alive() for t in threads)
+        return commits
+
+    clean = run_drill("clean", kill_after=0)
+    chaos = run_drill("chaos", kill_after=3)
+    for commits in (clean, chaos):
+        shards = sorted(k for k in commits if isinstance(k, int))
+        assert shards == list(range(n_shards))
+        # exactly once: no shard committed twice
+        assert all(commits[s] == 1 for s in shards)
+    # the "model": fold of deterministic per-shard contributions —
+    # identical iff the same shards committed exactly once
+    fold_clean = sorted(clean["values"].items())
+    fold_chaos = sorted(chaos["values"].items())
+    assert fold_clean == fold_chaos
+
+
+def test_journal_inspect_cli(tmp_path, capsys):
+    from dmlc_core_tpu import tools
+
+    d = str(tmp_path / "j")
+    j = jn.Journal(d)
+    j.append(jn.K_DATASET_SWITCH, fileset="fs://a")
+    j.append(jn.K_SHARD_GRANT, epoch=0, shard=0, rank=0,
+             fileset="fs://a", n_shards=2)
+    j.close()
+    assert tools.main(["journal", "inspect", d]) == 0
+    out = capsys.readouterr().out
+    assert "dataset_switch" in out and "[ok]" in out
+    assert tools.main(["journal", "inspect", d, "--json"]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert len(dump["records"]) == 2 and dump["crc_failures"] == 0
+    # CRC damage: nonzero exit + flagged record
+    wal = os.path.join(d, jn.WAL_NAME)
+    raw = bytearray(open(wal, "rb").read())
+    raw[10] ^= 0xFF
+    open(wal, "wb").write(bytes(raw))
+    assert tools.main(["journal", "inspect", d]) == 1
+    assert "CRC-FAIL" in capsys.readouterr().out

@@ -672,3 +672,64 @@ def test_trace_context_codec_gate_matches_repo_state():
                 x for x in lint.lint_file(f) if x[2] == "L017"
             ]
     assert findings == []
+
+
+def test_journal_crc_framing_flagged_in_tracker(tmp_path):
+    # a second CRC-framing site in the tracker tree splits the
+    # journal's wire format ownership
+    assert [c for c, _ in _tracker_findings(
+        "import binascii\nc = binascii.crc32(b'x')\n",
+        tmp_path)] == ["L018"]
+    assert [c for c, _ in _tracker_findings(
+        "import zlib\nc = zlib.crc32(payload)\n",
+        tmp_path) if c == "L018"] == ["L018"]
+    # alias-aware: module aliases and from-import aliases both count
+    assert [c for c, _ in _tracker_findings(
+        "import binascii as ba\nc = ba.crc32(b'x')\n",
+        tmp_path)] == ["L018"]
+    assert [c for c, _ in _tracker_findings(
+        "from binascii import crc32\nc = crc32(b'x')\n",
+        tmp_path)] == ["L018"]
+    assert [c for c, _ in _tracker_findings(
+        "from zlib import crc32 as c32\nc = c32(b'x')\n",
+        tmp_path) if c == "L018"] == ["L018"]
+    # per-line opt-out works like every other rule
+    assert [c for c, _ in _tracker_findings(
+        "import binascii\n"
+        "c = binascii.crc32(b'x')  # noqa: L018 (fixture)\n",
+        tmp_path) if c == "L018"] == []
+
+
+def test_journal_crc_framing_quiet_in_owner_and_outside_scope(tmp_path):
+    # journal.py owns the framing — crc32 AND struct framing are both
+    # allowed there (L018 owner exemption + the L015 exemption)
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True, exist_ok=True)
+    f = d / "journal.py"
+    f.write_text(
+        "import binascii\nimport struct\n"
+        "_HDR = struct.Struct('<II')\n"
+        "crc = binascii.crc32(b'payload')\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # outside the tracker tree nobody cares about crc32
+    assert codes("import binascii\nc = binascii.crc32(b'x')\n",
+                 tmp_path) == []
+    assert [c for c, _ in _lib_findings(
+        "import zlib\nc = zlib.crc32(b'x')\n", tmp_path)
+            if c == "L018"] == []
+    # an import alone, or an unrelated attribute, is not a finding
+    assert [c for c, _ in _tracker_findings(
+        "import binascii\nh = binascii.hexlify(b'x')\n",
+        tmp_path) if c == "L018"] == []
+
+
+def test_journal_crc_framing_gate_matches_repo_state():
+    """The real tree passes L018 (CRC framing lives only in
+    tracker/journal.py): run the shipped check over the tracker tree."""
+    repo = lint.REPO
+    findings = []
+    for f in sorted((repo / "dmlc_core_tpu" / "tracker").rglob("*.py")):
+        if "__pycache__" in f.parts:
+            continue
+        findings += [x for x in lint.lint_file(f) if x[2] == "L018"]
+    assert findings == []

@@ -128,6 +128,17 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  every flow arrow; carry the context
                                  as the opaque string tracing hands
                                  out.)
+  L018 journal CRC record framing outside tracker/journal.py (the
+                                 tracker's crash-recovery WAL — CRC-
+                                 framed, torn-tail-truncating — is
+                                 written and verified in exactly one
+                                 module: journal.py. A binascii.crc32/
+                                 zlib.crc32 call elsewhere in
+                                 dmlc_core_tpu/tracker/ starts a
+                                 second checksum site whose framing
+                                 can drift against the replay path and
+                                 turn a recoverable journal into one
+                                 strict replay refuses.)
   L016 socket-serving request loops in dmlc_core_tpu/io/ (exactly two
                                  modules are sanctioned servers there:
                                  blockcache.py — the shared-cache
@@ -438,7 +449,12 @@ _L015_EXEMPT = (
     "/dsserve/wire.py",
     "/tracker/protocol.py",
     "/tracker/collective.py",
+    "/tracker/journal.py",
 )
+# L018 is scoped to dmlc_core_tpu/tracker/ and exempts the journal,
+# which owns the WAL's CRC record framing (write AND verify sides)
+_L018_SCOPE_DIRS = ("dmlc_core_tpu/tracker/",)
+_L018_EXEMPT = ("/tracker/journal.py",)
 # L017 is scoped to the wire-speaking trees (everywhere a trace
 # context could plausibly be hand-rolled onto a protocol) and exempts
 # the flight recorder, which owns the context encoding
@@ -760,6 +776,49 @@ def _check_trace_context_codec(tree: ast.Module) -> Iterator[Tuple[int, str]]:
                 )
 
 
+_CRC_MODULES = ("binascii", "zlib")
+
+
+def _check_journal_crc_framing(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any call resolving to a crc32 — ``binascii.crc32(...)`` /
+    ``zlib.crc32(...)`` under any module alias, or the bare name bound
+    by ``from binascii import crc32`` (with or without an alias):
+    inside dmlc_core_tpu/tracker/ the crash-recovery WAL's CRC record
+    framing is a single-site concern (tracker/journal.py — the writer
+    AND the strict/lenient readers), mirroring the L006/L008-L017
+    pattern. A second checksum site can frame records the replay
+    cannot verify — corruption indistinguishable from a real torn
+    tail. Scoped in lint_file."""
+    fn_aliases = set()
+    mod_aliases = set(_CRC_MODULES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in _CRC_MODULES:
+            for alias in node.names:
+                if alias.name == "crc32":
+                    fn_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in _CRC_MODULES:
+                    mod_aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+            isinstance(f, ast.Attribute)
+            and f.attr == "crc32"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in mod_aliases
+        )
+        if hit:
+            yield node.lineno, (
+                "journal CRC record framing outside tracker/journal.py "
+                "(the WAL's checksum write/verify is confined there — "
+                "a second crc32 site can drift the frame format "
+                "against the replay path)"
+            )
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -778,6 +837,7 @@ CHECKS = [
     ("L015", _check_struct_framing),
     ("L016", _check_socket_serving_loops),
     ("L017", _check_trace_context_codec),
+    ("L018", _check_journal_crc_framing),
 ]
 
 
@@ -886,6 +946,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L017_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L017_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L018":
+            if posix.endswith(_L018_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L018_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L018_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
