@@ -44,9 +44,13 @@ import queue
 import socket
 import threading
 import time
+import weakref
 from typing import Dict, Iterator, List, Optional, Tuple
 
+import numpy as np
+
 from ..io.retry import RetryPolicy, is_transient
+from ..io.shm import ShmSegment, shm_available, shm_transport_enabled
 from ..io.split import fileset_signature
 from ..io.uri import URISpec
 from ..staging.batcher import Batch, BatchSpec
@@ -66,6 +70,118 @@ _RECV_WAIT = _REG.histogram(
 _RECONNECTS = _REG.counter(
     "dsserve.reconnects", help="client stream reconnect attempts"
 )
+_SHM_SLOTS = _REG.counter(
+    "dsserve.shm_slots",
+    help="slots received via the same-host shared-memory transport",
+)
+_TCP_SLOTS = _REG.counter(
+    "dsserve.tcp_slots", help="slots received as TCP payload bytes"
+)
+_HELD_BYTES = _REG.gauge(
+    "dsserve.held_bytes",
+    help="peak lease-mode slot bytes buffered awaiting SHARD_FIN commit",
+)
+
+#: pooled recv buffers (and server shm slots) start on a page boundary
+#: so an accelerator adoption path sees DMA-friendly alignment
+_PAGE = 4096
+
+
+def _hold_budget_bytes() -> int:
+    """``DMLC_DSSERVE_HOLD_MB`` (default 256): cap on lease-mode slot
+    bytes buffered client-side awaiting their SHARD_FIN commits, summed
+    across endpoints. Backpressure, never drop: a stream over budget
+    simply stops reading until another stream's commit frees bytes —
+    TCP flow control (or the shm ring running out of free slots)
+    propagates the stall to the server. The cap is a soft floor of one
+    in-flight shard: the LARGEST holder always keeps reading, so two
+    half-buffered shards can never deadlock each other. ``<= 0``
+    disables the budget."""
+    try:
+        mb = float(os.environ.get("DMLC_DSSERVE_HOLD_MB", "256"))
+    except ValueError:
+        mb = 256.0
+    return int(mb * (1 << 20)) if mb > 0 else 0
+
+
+class _SlotPool:
+    """Reusable page-aligned receive buffers — the recv-into path.
+
+    ``get()`` hands out an aligned uint8 array carved over a pooled
+    ``bytearray`` bank; a ``weakref.finalize`` on that array re-banks
+    the memory when the LAST view over it dies. numpy collapses every
+    sub-view's ``.base`` to the carved array itself (its own base is
+    the bytearray's buffer, not an ndarray, so collapsing stops there),
+    which makes the finalizer exact: it cannot fire while read_batch
+    sections, a lease-buffered batch, or an in-flight staging transfer
+    still alias the bytes. The same alive-until-released discipline
+    blockcache leases give shm blocks, enforced by the refcount instead
+    of an RPC.
+
+    Shared process-wide (module ``_POOL``): the bank size is the
+    largest packed slot any stream has carried, so per-epoch client
+    instances inherit warm banks instead of re-learning the slot size —
+    after the very first slot of the first epoch, the payload path
+    allocates nothing (``dsserve.recv_alloc_bytes`` stays flat)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._free: List[bytearray] = []
+        self._cap = 0
+        self.banks = 0  # live banks ever carved (diagnostic)
+
+    def ensure(self, nbytes: int) -> None:
+        """Grow the bank size to fit ``nbytes`` payloads. Undersized
+        banks are dropped from the free list here and retire for good
+        when their outstanding views die (their finalizers re-bank
+        only banks of the CURRENT size)."""
+        need = int(nbytes)
+        with self._lock:
+            if need > self._cap:
+                self._cap = need
+                self.banks -= len(self._free)
+                self._free.clear()
+
+    def get(self) -> Optional[np.ndarray]:
+        """One aligned bank-sized buffer, or None before the first
+        ``ensure()`` sized the pool (callers fall back to the
+        allocating reader for that slot, then ensure)."""
+        with self._lock:
+            if not self._cap:
+                return None
+            cap = self._cap
+            mem = self._free.pop() if self._free else None
+            if mem is None:
+                mem = bytearray(cap + _PAGE)
+                self.banks += 1
+        off = (-np.frombuffer(mem, dtype=np.uint8).ctypes.data) % _PAGE
+        out = np.frombuffer(mem, dtype=np.uint8, count=cap, offset=off)
+        weakref.finalize(out, self._recycle, mem)
+        return out
+
+    def _recycle(self, mem: bytearray) -> None:
+        with self._lock:
+            if len(mem) == self._cap + _PAGE:
+                self._free.append(mem)
+            else:
+                self.banks -= 1  # pool grew past this bank: retire
+
+
+#: process-wide pool — every DsServeBatches (one per epoch) shares it
+_POOL = _SlotPool()
+
+
+def _send_ack(sock, lock: threading.Lock, name: str) -> None:
+    """finalize hook: the last view over a shm slot died — hand the
+    segment back to the server's ring. Runs on whatever thread dropped
+    the final reference, so the frame write is serialized by the
+    per-connection send lock; a dead socket is fine (the server frees
+    every segment at stream teardown anyway)."""
+    try:
+        with lock:
+            wire.send_frame(sock, wire.KIND_OK, {"ack": name})
+    except Exception:
+        pass
 
 
 def parse_dsserve_uri(uri: str) -> Tuple[List[Tuple[str, int]], str]:
@@ -102,7 +218,7 @@ class _CommitRefused(Error):
 class _EndpointState:
     __slots__ = (
         "slots", "bytes", "reconnects", "dead", "finished", "sock",
-        "delivered",
+        "delivered", "shm_ok", "shm_slots", "tcp_slots",
     )
 
     def __init__(self) -> None:
@@ -117,6 +233,13 @@ class _EndpointState:
         # local) so a connection dropping mid-stream cannot roll the
         # reconnect HELLO's start_seq back and re-deliver slots.
         self.delivered = 0
+        # shm eligibility persists ACROSS reconnects: once a segment
+        # fails (unlinked under us, probe mismatch) the endpoint stays
+        # on TCP for the rest of this stream's life — the degrade is
+        # one reconnect, never a flap loop
+        self.shm_ok = True
+        self.shm_slots = 0
+        self.tcp_slots = 0
 
 
 class DsServeBatches:
@@ -134,6 +257,13 @@ class DsServeBatches:
     acked (``recorded`` | ``duplicate``) — tests and bench hash
     per-shard payload bytes from these for end-to-end identity.
     """
+
+    #: producer-contract hint (staging/pipeline.py): delivered batches
+    #: sit in stable page-aligned buffers (pooled recv banks or shm
+    #: segments) that stay alive until every view dies, so the pipeline
+    #: may skip its dispatch_pack copy and device_put ``batch.packed``
+    #: directly — the received slot IS the staging slot
+    adopt_slots = True
 
     def __init__(
         self,
@@ -186,6 +316,12 @@ class DsServeBatches:
         self._out: "queue.Queue" = queue.Queue(maxsize=max(1, prefetch))
         self._kill = threading.Event()
         self._commit_lock = threading.Lock()
+        # lease-mode hold accounting (DMLC_DSSERVE_HOLD_MB): bytes of
+        # slots buffered awaiting commit, total and per endpoint
+        self._hold_budget = _hold_budget_bytes()
+        self._held = 0
+        self._held_by: Dict[int, int] = {}
+        self._held_cv = threading.Condition()
         self._eps = [_EndpointState() for _ in self.endpoints]
         self.shards_recorded = 0
         self.shards_duplicate = 0
@@ -284,7 +420,40 @@ class DsServeBatches:
             meta["part"] = i
             meta["nparts"] = len(self.endpoints)
             meta["start_seq"] = start_seq
+        if (
+            self._eps[i].shm_ok
+            and shm_transport_enabled()
+            and shm_available()
+        ):
+            # same-host offer: the server compares host + uid against
+            # its own before offering a probe segment, and a stream
+            # that never offers is plain TCP (absent keys are how old
+            # clients and hand-rolled test HELLOs opt out)
+            meta["shm"] = True
+            meta["host"] = socket.gethostname()
+            meta["uid"] = os.getuid() if hasattr(os, "getuid") else -1
         return meta
+
+    def _confirm_shm(self, i: int, sock, ok_meta: Dict) -> None:
+        """Second leg of the shm handshake: map the server's probe
+        segment, verify the magic it wrote, answer with the verdict.
+        Both sides prove they share a shm namespace — a hostname
+        collision across containers fails the read here, harmlessly,
+        and the stream runs TCP."""
+        st = self._eps[i]
+        ok = False
+        try:
+            seg = ShmSegment(str(ok_meta["shm_probe"]))
+            try:
+                magic = wire.SHM_MAGIC
+                ok = bytes(seg.buf[: len(magic)]) == magic
+            finally:
+                seg.close()
+        except (OSError, ValueError, KeyError):
+            ok = False
+        if not ok:
+            st.shm_ok = False  # stop offering on reconnects
+        wire.send_frame(sock, wire.KIND_OK, {"shm": bool(ok)})
 
     def _connect(self, i: int, start_seq: int):
         host, port = self.endpoints[i]
@@ -307,6 +476,8 @@ class DsServeBatches:
                 )
             if kind != wire.KIND_OK:
                 raise Error(f"dsserve: expected OK, got frame kind {kind}")
+            if "shm_probe" in meta:
+                self._confirm_shm(i, sock, meta)
             sock.settimeout(None)
             return sock
         except BaseException:
@@ -322,6 +493,39 @@ class DsServeBatches:
             except queue.Full:
                 continue
         return False
+
+    # -- lease-mode hold budget (DMLC_DSSERVE_HOLD_MB) -----------------------
+    def _hold_add(self, i: int, n: int) -> None:
+        with self._held_cv:
+            self._held += n
+            self._held_by[i] = self._held_by.get(i, 0) + n
+            _HELD_BYTES.set_max(self._held)
+
+    def _hold_release(self, i: int, n: int) -> None:
+        if n <= 0:
+            return
+        with self._held_cv:
+            self._held -= n
+            self._held_by[i] = self._held_by.get(i, 0) - n
+            self._held_cv.notify_all()
+
+    def _hold_wait(self, i: int) -> None:
+        """Park this stream while the hold budget is blown AND some
+        other endpoint holds more than we do. The largest holder never
+        waits — it is the stream a commit is nearest on — so progress
+        is guaranteed and the budget degrades to a soft floor of one
+        in-flight shard rather than a deadlock of mutually-parked
+        half-buffered shards."""
+        if not self._hold_budget:
+            return
+        with self._held_cv:
+            while (
+                not self._kill.is_set()
+                and self._held > self._hold_budget
+                and self._held_by.get(i, 0)
+                < max(self._held_by.values() or (0,))
+            ):
+                self._held_cv.wait(0.1)
 
     def _commit_shard(self, shard: int, pending: List) -> None:
         """The exactly-once decision point: this client's ``shard_done``
@@ -432,57 +636,143 @@ class DsServeBatches:
                 st.finished = True
                 self._put(("end", i))
 
+    def _shm_payload(
+        self, i: int, sock, send_lock, segs: Dict[str, ShmSegment],
+        desc: Dict,
+    ) -> np.ndarray:
+        """A shm slot descriptor → zero-copy uint8 view over the named
+        segment. The finalize on the view sends the segment-reuse ack
+        when the last alias dies (read_batch sections collapse their
+        ``.base`` to this array). ANY failure marks the endpoint
+        TCP-only and raises a transient ``Error`` — the reconnect
+        HELLO then negotiates plain TCP and the ledger (lease mode) or
+        start_seq (static) re-serves what the drop stranded: the
+        silent-degrade contract."""
+        st = self._eps[i]
+        try:
+            name = str(desc["seg"])
+            nbytes = int(desc["nbytes"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise Error(f"dsserve: bad shm slot descriptor: {e}") from e
+        try:
+            seg = segs.get(name)
+            if seg is None:
+                seg = ShmSegment(name)
+                segs[name] = seg
+            if not 0 <= nbytes <= len(seg.buf):
+                raise Error(
+                    f"dsserve: shm slot claims {nbytes} bytes but segment "
+                    f"{name!r} holds {len(seg.buf)}"
+                )
+        except (OSError, ValueError, Error) as e:
+            st.shm_ok = False
+            raise Error(
+                f"dsserve: shm transport failed ({e}); degrading this "
+                "endpoint to TCP"
+            ) from e
+        payload = np.frombuffer(seg.buf, dtype=np.uint8, count=nbytes)
+        weakref.finalize(payload, _send_ack, sock, send_lock, name)
+        return payload
+
     def _drain_stream(self, i: int, sock) -> None:
         """Pump one connection until EPOCH_END. Lease-mode slots buffer
         per shard until SHARD_FIN commits them (a FIN with zero slots
         is a legitimately EMPTY micro-shard and is committed too);
         static-mode slots deliver immediately (their stripe is
         exclusively this endpoint's, the delivered count is the resume
-        point)."""
+        point).
+
+        Slot payloads land zero-copy: TCP frames ``recv_into`` a pooled
+        page-aligned bank (``_SlotPool``), shm frames map the server's
+        segment in place — either way ``read_batch`` aliases the bytes
+        where they already are and nothing is memcpy'd client-side."""
         st = self._eps[i]
         pending: List = []
         pending_shard: Optional[int] = None
-        while not self._kill.is_set():
-            kind, meta, payload, seq, _epoch = wire.recv_frame(sock)
-            if kind == wire.KIND_SLOT:
-                batch = wire.read_batch(meta, payload)
-                shard = int(meta.get("shard", -1))
-                st.slots += 1
-                st.bytes += payload.nbytes
-                if self.mode == "lease":
-                    if pending_shard is None:
-                        pending_shard = shard
-                    elif shard != pending_shard:
-                        raise Error(
-                            f"dsserve: interleaved shards on one stream "
-                            f"({pending_shard} then {shard})"
-                        )
-                    pending.append((batch, seq, meta.get("tc")))
+        held = 0  # bytes in `pending`, re-released on commit or death
+        segs: Dict[str, ShmSegment] = {}
+        send_lock = threading.Lock()  # serializes finalize-thread acks
+        try:
+            while not self._kill.is_set():
+                self._hold_wait(i)
+                buf = _POOL.get()
+                if buf is None:
+                    kind, meta, payload, seq, _epoch = wire.recv_frame(sock)
                 else:
-                    if self.on_slot is not None:
-                        self.on_slot(shard, seq, batch.packed)
-                    if not self._put(("batch", batch, meta.get("tc"))):
-                        return
-                    st.delivered += 1
-            elif kind == wire.KIND_SHARD_FIN:
-                shard = int(meta.get("shard", -1))
-                if self.mode == "lease":
-                    if pending_shard is not None and shard != pending_shard:
-                        raise Error(
-                            f"dsserve: SHARD_FIN for {shard} while shard "
-                            f"{pending_shard} is in flight"
+                    kind, meta, payload, seq, _epoch = wire.read_frame_into(
+                        sock, buf
+                    )
+                    buf = None  # the payload view is the only keep-alive
+                if kind == wire.KIND_SLOT:
+                    shm_desc = meta.get("shm")
+                    if shm_desc is not None:
+                        payload = self._shm_payload(
+                            i, sock, send_lock, segs, shm_desc
                         )
-                    self._commit_shard(shard, pending)
-                pending = []
-                pending_shard = None
-            elif kind == wire.KIND_EPOCH_END:
-                return
-            elif kind == wire.KIND_ERROR:
-                raise Error(
-                    f"dsserve server error: {meta.get('error', meta)!r}"
-                )
-            else:
-                raise Error(f"dsserve: unexpected frame kind {kind}")
+                        st.shm_slots += 1
+                        _SHM_SLOTS.inc()
+                    else:
+                        st.tcp_slots += 1
+                        _TCP_SLOTS.inc()
+                        if payload.nbytes > 0:
+                            _POOL.ensure(payload.nbytes)
+                    batch = wire.read_batch(meta, payload)
+                    shard = int(meta.get("shard", -1))
+                    st.slots += 1
+                    st.bytes += payload.nbytes
+                    if self.mode == "lease":
+                        if pending_shard is None:
+                            pending_shard = shard
+                        elif shard != pending_shard:
+                            raise Error(
+                                f"dsserve: interleaved shards on one "
+                                f"stream ({pending_shard} then {shard})"
+                            )
+                        pending.append((batch, seq, meta.get("tc")))
+                        self._hold_add(i, payload.nbytes)
+                        held += payload.nbytes
+                    else:
+                        if self.on_slot is not None:
+                            self.on_slot(shard, seq, batch.packed)
+                        if not self._put(("batch", batch, meta.get("tc"))):
+                            return
+                        st.delivered += 1
+                    del batch, payload
+                elif kind == wire.KIND_SHARD_FIN:
+                    shard = int(meta.get("shard", -1))
+                    if self.mode == "lease":
+                        if (
+                            pending_shard is not None
+                            and shard != pending_shard
+                        ):
+                            raise Error(
+                                f"dsserve: SHARD_FIN for {shard} while "
+                                f"shard {pending_shard} is in flight"
+                            )
+                        self._commit_shard(shard, pending)
+                        self._hold_release(i, held)
+                        held = 0
+                    pending = []
+                    pending_shard = None
+                elif kind == wire.KIND_EPOCH_END:
+                    return
+                elif kind == wire.KIND_ERROR:
+                    raise Error(
+                        f"dsserve server error: {meta.get('error', meta)!r}"
+                    )
+                else:
+                    raise Error(f"dsserve: unexpected frame kind {kind}")
+        finally:
+            # stranded pending bytes die with the connection (the
+            # ledger re-serves the shard) — free their budget now
+            del pending
+            self._hold_release(i, held)
+            for seg in segs.values():
+                try:
+                    seg.close()
+                except BufferError:
+                    pass  # live views: the mapping outlives them, then
+                    #       the mmap is reclaimed with the last view
 
     # -- producer contract ---------------------------------------------------
     def __iter__(self) -> Iterator[Batch]:
@@ -548,6 +838,10 @@ class DsServeBatches:
             "shards_recorded": self.shards_recorded,
             "shards_duplicate": self.shards_duplicate,
             "recv_wait_secs": round(self.recv_wait_secs, 4),
+            "shm_slots": sum(s.shm_slots for s in self._eps),
+            "tcp_slots": sum(s.tcp_slots for s in self._eps),
+            "recv_alloc_bytes": wire.recv_alloc_bytes(),
+            "pool_banks": _POOL.banks,
         }
 
     def close(self) -> None:

@@ -41,6 +41,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..concurrency.threaded_iter import ThreadedIter
+from ..io.codec import available_codecs, get_codec
+from ..io.shm import ShmSegment, shm_available, shm_transport_enabled
 from ..staging.batcher import BatchSpec
 from ..telemetry import default_registry as _default_registry
 from ..telemetry import tracing as _tracing
@@ -97,6 +99,340 @@ def default_send_timeout() -> float:
         return 300.0
 
 
+_PAGE = 4096  # shm ring slots are page-multiples (client adoption path)
+
+#: spanfetch's AIMD bandwidth-sample window: the wire compressor
+#: re-evaluates its compress/plain decision on the same cadence
+_REEVAL_WINDOW = 8
+
+
+def _shm_ring_slots() -> int:
+    """``DMLC_DSSERVE_SHM_SLOTS`` (default 8): single-slot shm segments
+    per stream. Bounds same-host memory at ring × slot bytes; when the
+    client buffers more unacked slots than the ring holds, overflow
+    slots travel inline over TCP — backpressure by fallback, never a
+    deadlock."""
+    try:
+        return max(1, int(os.environ.get("DMLC_DSSERVE_SHM_SLOTS", "8")))
+    except ValueError:
+        return 8
+
+
+def _shm_break_after() -> int:
+    """``DMLC_DSSERVE_SHM_BREAK_AFTER`` (default 0 = off): chaos knob —
+    after N shm slots on a stream, every further shm descriptor names a
+    segment that was never created, so the client's ``shm_open``
+    ENOENTs and the degrade-to-TCP path is exercised deterministically
+    (the shm analogue of DMLC_DSSERVE_KILL_AFTER_SLOTS)."""
+    try:
+        return max(
+            0, int(os.environ.get("DMLC_DSSERVE_SHM_BREAK_AFTER", "0") or 0)
+        )
+    except ValueError:
+        return 0
+
+
+class _ShmRing:
+    """Per-stream ring of single-slot POSIX shm segments.
+
+    Each in-flight slot occupies ONE whole segment: the client tracks
+    slot liveness with a single finalizer per mapped segment and acks
+    it (an OK frame naming the segment) when the last view dies; only
+    an acked segment is rewritten. Segments are cut lazily at the first
+    send of each size generation — a bigger slot retires the free list
+    and starts a new generation under fresh names, so a stale
+    descriptor can never alias resized memory. ``slot_for`` never
+    blocks: a ring with no free segment returns None and the caller
+    ships that slot inline over TCP, which is what makes a client
+    buffering more than ring-many slots safe rather than deadlocked.
+
+    Teardown unlinks every segment; a client still holding views keeps
+    its private mappings alive (POSIX semantics) and simply never
+    re-opens the names."""
+
+    def __init__(self, limit: int, break_after: int) -> None:
+        self._lock = threading.Lock()
+        self._free: list = []
+        self._busy: Dict[str, ShmSegment] = {}
+        self._segsize = 0
+        self._limit = max(1, limit)
+        self._made = 0
+        # decimal pid + random suffix: unique across live processes AND
+        # across restarts of the same pid slot (crashed owners leak
+        # their names until cleanup; fresh names never collide with
+        # them)
+        self._prefix = (
+            f"dmlc-dss-{os.getpid()}-{int.from_bytes(os.urandom(4), 'big')}"
+        )
+        self.break_after = break_after
+        self.shm_sent = 0
+        self.tcp_fallbacks = 0
+
+    def _next_name(self) -> str:
+        self._made += 1
+        return f"{self._prefix}-{self._made}"
+
+    def make_probe(self) -> ShmSegment:
+        """Handshake probe: a one-page segment carrying SHM_MAGIC the
+        client must read back. Caller closes + unlinks it once the
+        confirmation frame lands."""
+        with self._lock:
+            name = self._next_name()
+        seg = ShmSegment(name, create=True, size=_PAGE)
+        seg.buf[: len(wire.SHM_MAGIC)] = wire.SHM_MAGIC
+        return seg
+
+    def slot_for(self, payload) -> Optional[str]:
+        """Copy ``payload`` into a free segment and return its name;
+        None = ring exhausted, send this slot over TCP."""
+        view = memoryview(payload).cast("B")
+        n = len(view)
+        with self._lock:
+            if self.break_after and self.shm_sent >= self.break_after:
+                self.shm_sent += 1
+                return self._next_name()  # never created: client ENOENTs
+            need = -(-max(n, 1) // _PAGE) * _PAGE
+            if need > self._segsize:
+                for seg in self._free:
+                    self._retire(seg)
+                self._free = []
+                self._segsize = need
+            if self._free:
+                seg = self._free.pop()
+            elif len(self._busy) < self._limit:
+                try:
+                    seg = ShmSegment(
+                        self._next_name(), create=True, size=self._segsize
+                    )
+                except (OSError, ValueError):
+                    self.tcp_fallbacks += 1
+                    return None
+            else:
+                self.tcp_fallbacks += 1
+                return None
+            self._busy[seg.name] = seg
+        seg.buf[:n] = view
+        self.shm_sent += 1
+        return seg.name
+
+    def release(self, name: str) -> None:
+        """Client ack: the segment may be rewritten (or retired, if the
+        ring's size generation moved past it)."""
+        with self._lock:
+            seg = self._busy.pop(name, None)
+            if seg is None:
+                return
+            if len(seg.buf) == self._segsize:
+                self._free.append(seg)
+            else:
+                self._retire(seg)
+
+    @staticmethod
+    def _retire(seg: ShmSegment) -> None:
+        try:
+            seg.close()
+            seg.unlink()
+        except (OSError, BufferError):
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            for seg in self._free:
+                self._retire(seg)
+            for seg in self._busy.values():
+                self._retire(seg)
+            self._free = []
+            self._busy = {}
+
+
+class _SendThrottle:
+    """``DMLC_DSSERVE_WIRE_BPS`` (default 0 = off): deterministic
+    egress pacing — sleeps after each send so the stream's average wire
+    rate tracks the configured bytes/sec. A bench instrument: it turns
+    loopback into a reproducible slow link so the adaptive wire
+    compressor's low-bandwidth win is measurable, and because the pace
+    is charged on bytes ACTUALLY sent, compressed slots genuinely
+    clear the link sooner."""
+
+    def __init__(self) -> None:
+        try:
+            self.bps = float(
+                os.environ.get("DMLC_DSSERVE_WIRE_BPS", "0") or 0
+            )
+        except ValueError:
+            self.bps = 0.0
+        self._debt = 0.0
+        self._last = time.monotonic()
+
+    def pace(self, nbytes: int) -> None:
+        if self.bps <= 0 or nbytes <= 0:
+            return
+        now = time.monotonic()
+        self._debt = (
+            max(0.0, self._debt - (now - self._last)) + nbytes / self.bps
+        )
+        self._last = now
+        if self._debt > 0.001:
+            time.sleep(self._debt)
+
+
+class _WireCompressor:
+    """Per-connection adaptive SLOT compression (io/codec.py codecs).
+
+    ``DMLC_DSSERVE_WIRE_CODEC``: ``off`` disables, a codec name pins
+    the codec, ``auto`` (default) picks zstd when installed, else zlib
+    — in every enabled mode the COMPRESS/plain decision stays measured
+    and per-connection. The decision: compress while
+
+        n/codec_bps + (n × ratio)/wire_bps  <  0.97 × n/wire_bps
+
+    i.e. codec time plus the smaller send beats the plain send with 3%
+    hysteresis, using a wire-bandwidth EWMA over the bytes each send
+    actually put on the wire. Re-evaluated every ``_REEVAL_WINDOW``
+    sends — spanfetch's AIMD sampling cadence — so a link that speeds
+    up (or a payload mix that stops compressing) flips the stream back
+    to plain within a window, no knob change.
+
+    Codec throughput and payload ratio are properties of the CPU and
+    the slot mix, not the connection, so their estimates live in a
+    process-wide table (``_shared``): while a stream compresses, every
+    real compression refreshes them for free; while every stream ships
+    plain, one ``_PROBE_CAP``-capped probe per ``_PROBE_TTL`` seconds
+    keeps them from going stale. A fresh connection therefore pays at
+    most one small probe EVER before its first decision (at send
+    ``_REEVAL_WINDOW``, once the wire EWMA has samples) — short
+    streams on a fast wire ride plain at plain's cost, which is what
+    keeps the high-bandwidth path inside its 3% regression budget."""
+
+    #: probe compressions run on at most this payload prefix: the cost
+    #: of estimating on a stream that will DECLINE must stay trivial
+    _PROBE_CAP = 128 * 1024
+    #: while no stream compresses, re-probe (refresh ratio/throughput)
+    #: at most this often per process
+    _PROBE_TTL = 5.0
+    #: codec name -> (codec_bps, ratio, measured_at) across connections
+    _shared: Dict[str, tuple] = {}
+
+    def __init__(self) -> None:
+        name = (
+            os.environ.get("DMLC_DSSERVE_WIRE_CODEC", "auto")
+            .strip()
+            .lower()
+        )
+        self._codec = None
+        if name not in ("", "off", "0", "none", "raw"):
+            try:
+                if name == "auto":
+                    pick = (
+                        "zstd" if "zstd" in available_codecs() else "zlib"
+                    )
+                    self._codec = get_codec(pick)
+                else:
+                    self._codec = get_codec(name)
+            except Error:
+                self._codec = None  # unknown/unavailable: plain wire
+        self._wire_bps = 0.0
+        self._codec_bps = 0.0
+        self._ratio = 1.0
+        self._sends = 0
+        self._on = False
+        self.compressed_sends = 0
+
+    def observe_send(self, nbytes: int, secs: float) -> None:
+        """EWMA over wire throughput as actually experienced (pacing
+        included) — compressed sends count their WIRE bytes, so the
+        estimate stays live in either regime."""
+        if secs <= 0 or nbytes <= 0:
+            return
+        bps = nbytes / secs
+        self._wire_bps = (
+            bps
+            if self._wire_bps == 0.0
+            else 0.8 * self._wire_bps + 0.2 * bps
+        )
+
+    def _decide(self, n: int) -> None:
+        if self._wire_bps <= 0 or self._codec_bps <= 0:
+            self._on = False
+            return
+        plain = n / self._wire_bps
+        with_codec = n / self._codec_bps + (n * self._ratio) / self._wire_bps
+        self._on = self._ratio < 1.0 and with_codec < 0.97 * plain
+
+    def maybe_compress(self, payload):
+        """payload → (wire_payload, meta_extra, flags). Re-decides on
+        the window cadence from the connection's wire EWMA plus the
+        shared codec estimates (probing only when those are missing or
+        stale), then applies the standing decision — a compressed send
+        doubles as a full-payload estimate refresh."""
+        if self._codec is None:
+            return payload, None, 0
+        n = payload.nbytes
+        idx = self._sends
+        self._sends += 1
+        if n <= 0:
+            return payload, None, 0
+        # decision cadence: send 0 of a fresh connection has no wire
+        # samples yet, so the first window always ships plain and just
+        # measures — by send _REEVAL_WINDOW the EWMA is live
+        if idx % _REEVAL_WINDOW == 0 and idx > 0:
+            stats = _WireCompressor._shared.get(self._codec.name)
+            now = time.monotonic()
+            if stats is None or (
+                not self._on and now - stats[2] > self._PROBE_TTL
+            ):
+                # capped probe: the head-of-slot prefix skews the ratio
+                # estimate toward whichever section leads, but the 3%
+                # hysteresis plus the free full-payload refresh once
+                # compressing bounds what a biased estimate can cost
+                probe = bytes(memoryview(payload[: self._PROBE_CAP]))
+                t0 = time.monotonic()
+                clen = len(self._codec.compress(probe))
+                dt = max(time.monotonic() - t0, 1e-9)
+                stats = (len(probe) / dt, clen / max(len(probe), 1), now)
+                _WireCompressor._shared[self._codec.name] = stats
+            self._codec_bps, self._ratio = stats[0], stats[1]
+            self._decide(n)
+        if not self._on:
+            return payload, None, 0
+        t0 = time.monotonic()
+        comp = self._codec.compress(bytes(memoryview(payload)))
+        dt = max(time.monotonic() - t0, 1e-9)
+        prev = _WireCompressor._shared.get(self._codec.name)
+        bps, ratio = n / dt, len(comp) / n
+        if prev is not None:
+            bps = 0.8 * prev[0] + 0.2 * bps
+            ratio = 0.8 * prev[1] + 0.2 * ratio
+        _WireCompressor._shared[self._codec.name] = (
+            bps, ratio, time.monotonic()
+        )
+        if len(comp) >= n:
+            return payload, None, 0  # incompressible slot: send plain
+        self.compressed_sends += 1
+        return (
+            comp,
+            {"codec": self._codec.name, "raw_len": n},
+            wire.FLAG_COMPRESSED,
+        )
+
+
+class _DataPlane:
+    """One stream's slot-transport state: shm ring (None = TCP only),
+    adaptive wire compressor, bench pacing throttle."""
+
+    __slots__ = ("ring", "comp", "throttle")
+
+    def __init__(
+        self,
+        ring: Optional[_ShmRing],
+        comp: _WireCompressor,
+        throttle: _SendThrottle,
+    ) -> None:
+        self.ring = ring
+        self.comp = comp
+        self.throttle = throttle
+
+
 def _uri_with_epoch(uri: str, epoch: int) -> str:
     """Thread the stream's epoch into the dataset URI sugar (indexed
     sources resolve ``?epoch=E`` to the epoch's deterministic shuffle
@@ -132,6 +468,11 @@ class _StreamConfig:
             self.nparts = int(meta.get("nparts", 1))
             self.start_seq = int(meta.get("start_seq", 0))
             self.fileset = meta.get("fileset")
+            # same-host shm offer (absent keys = a client that cannot
+            # or will not map shm; the stream is plain TCP)
+            self.shm = bool(meta.get("shm", False))
+            self.client_host = str(meta.get("host", ""))
+            self.client_uid = int(meta.get("uid", -2))
         except (KeyError, TypeError, ValueError) as e:
             raise Error(f"dsserve: bad HELLO config: {e}") from e
         if self.mode not in ("lease", "static"):
@@ -217,6 +558,7 @@ class DsServeServer:
         self.slots_served = 0
         self.bytes_served = 0
         self.shards_streamed = 0
+        self.shm_slots_sent = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "DsServeServer":
@@ -300,8 +642,75 @@ class DsServeServer:
             self._depth += d
             _QDEPTH.set(self._depth)
 
+    def _shm_eligible(self, cfg: _StreamConfig) -> bool:
+        """Offer shm only when BOTH sides opted in and the HELLO's
+        host + uid match this process — the cheap pre-filter; the probe
+        round-trip is the actual proof of a shared namespace."""
+        if not (cfg.shm and shm_transport_enabled() and shm_available()):
+            return False
+        if cfg.client_host != socket.gethostname():
+            return False
+        uid = os.getuid() if hasattr(os, "getuid") else -1
+        return cfg.client_uid == uid
+
+    def _negotiate_shm(self, conn, cfg: _StreamConfig, ok_meta: Dict):
+        """Run the OK + probe handshake; returns the stream's _ShmRing
+        (None = plain TCP). The probe segment proves the client maps
+        THIS server's shm namespace: the OK carries the probe name, the
+        client reads the magic back and confirms in its own OK frame.
+        Any hiccup — create failure, refused or garbled confirmation —
+        falls back to TCP without failing the stream."""
+        ring = None
+        probe = None
+        if self._shm_eligible(cfg):
+            try:
+                ring = _ShmRing(_shm_ring_slots(), _shm_break_after())
+                probe = ring.make_probe()
+                ok_meta["shm_probe"] = probe.name
+            except (OSError, ValueError):
+                ring = None
+                probe = None
+        wire.send_frame(conn, wire.KIND_OK, ok_meta)
+        if ring is None:
+            return None
+        confirmed = False
+        try:
+            kind, m2, _p, _s, _e = wire.recv_frame(conn)
+            confirmed = kind == wire.KIND_OK and bool(
+                isinstance(m2, dict) and m2.get("shm")
+            )
+        except (OSError, ConnectionError, Error):
+            raise  # a dead handshake socket fails the stream normally
+        finally:
+            try:
+                probe.close()
+                probe.unlink()
+            except (OSError, BufferError):
+                pass
+        if not confirmed:
+            ring.close()
+            return None
+        return ring
+
+    def _ack_loop(self, conn, ring: _ShmRing) -> None:
+        """Per-stream shm ack reader — the ONLY post-handshake recv on
+        the connection: each client OK frame names a segment whose last
+        view died, freeing its ring slot for rewrite. Exits with the
+        socket; segments never acked are reclaimed by ring.close()."""
+        while True:
+            try:
+                kind, meta, _p, _s, _e = wire.recv_frame(conn)
+            except socket.timeout:
+                continue  # idle stream: keep listening for late acks
+            except (OSError, ConnectionError, Error):
+                return
+            if kind == wire.KIND_OK and "ack" in meta:
+                ring.release(str(meta["ack"]))
+
     def _serve_client(self, conn, addr) -> None:
         _CLIENTS.inc()
+        ring = None
+        ack_thread = None
         try:
             conn.settimeout(30.0)
             kind, meta, _payload, _seq, _ep = wire.recv_frame(conn)
@@ -318,14 +727,23 @@ class DsServeServer:
                 # client must fail the stream loudly instead of
                 # wedging it forever
                 conn.settimeout(default_send_timeout())
-                wire.send_frame(
-                    conn, wire.KIND_OK,
+                ring = self._negotiate_shm(
+                    conn, cfg,
                     {"mode": cfg.mode, "rank": self.rank, "pid": os.getpid()},
                 )
+            if ring is not None:
+                ack_thread = threading.Thread(
+                    target=self._ack_loop,
+                    args=(conn, ring),
+                    daemon=True,
+                    name="dsserve-shm-ack",
+                )
+                ack_thread.start()
+            plane = _DataPlane(ring, _WireCompressor(), _SendThrottle())
             if cfg.mode == "lease":
-                self._stream_leased(conn, cfg)
+                self._stream_leased(conn, cfg, plane)
             else:
-                self._stream_static(conn, cfg)
+                self._stream_static(conn, cfg, plane)
         except (Error, ValueError, KeyError) as e:
             logger.warning("dsserve stream from %s failed: %s", addr, e)
             try:
@@ -338,14 +756,30 @@ class DsServeServer:
             logger.info("dsserve client %s disconnected: %s", addr, e)
         finally:
             _CLIENTS.dec()
+            # teardown ORDER is the correctness: descriptors for
+            # segments the client has not mapped yet may still sit in
+            # its socket buffer after this side finishes a fast stream
+            # — unlinking now would ENOENT every one of them. The ack
+            # loop exits exactly when the client's socket dies (EOF
+            # after it consumed the whole stream, or reset), so joining
+            # it FIRST makes every name safe to unlink: mapped segments
+            # survive via the client's private mappings, unmapped ones
+            # can no longer be asked for. The send-timeout bound keeps
+            # a wedged client from pinning the ring forever (it then
+            # degrades to TCP through the reconnect path, exactly-once
+            # intact).
+            if ack_thread is not None:
+                ack_thread.join(timeout=default_send_timeout())
             try:
                 conn.close()
             except OSError:
                 pass
+            if ring is not None:
+                ring.close()
 
     def _send_slots(
         self, conn, producer, shard: int, epoch: int, seq0: int,
-        skip: int = 0,
+        plane: _DataPlane, skip: int = 0,
     ) -> int:
         """Stream one producer's batches as SLOT frames; returns the
         next seq (the static-mode path). Production runs
@@ -386,14 +820,17 @@ class DsServeServer:
                     skipped += 1
                     seq += 1
                     continue
-                seq = self._send_one(conn, batch, shard, epoch, seq)
+                seq = self._send_one(conn, batch, shard, epoch, seq, plane)
         finally:
             it.destroy(timeout=1.0)
             # rewind the gauge by the discarded produced-but-untaken
             # slots (see the leased path's teardown note)
             self._tick_depth(taken - produced[0])
 
-    def _send_one(self, conn, batch, shard: int, epoch: int, seq: int) -> int:
+    def _send_one(
+        self, conn, batch, shard: int, epoch: int, seq: int,
+        plane: _DataPlane,
+    ) -> int:
         meta = wire.slot_meta(batch, shard)
         # each slot carries the server's flow id: the trainer lands it
         # inside its dsserve_recv_wait span, so a starved consumer's
@@ -401,18 +838,43 @@ class DsServeServer:
         tc = _tracing.rpc_context()
         if tc:
             meta["tc"] = tc
+        raw_n = batch.packed.nbytes
+        payload = batch.packed
+        flags = 0
+        if plane.ring is not None:
+            name = plane.ring.slot_for(payload)
+            if name is not None:
+                # the slot bytes are already in the segment — the wire
+                # carries only the descriptor (no crc: there is no wire
+                # medium under the payload to tear)
+                meta["shm"] = {"seg": name, "nbytes": raw_n}
+                payload = None
+                self.shm_slots_sent += 1
+        if payload is not None:
+            payload, extra, flags = plane.comp.maybe_compress(payload)
+            if extra:
+                meta.update(extra)
+        t0 = time.monotonic()
         sent = wire.send_frame(
-            conn, wire.KIND_SLOT, meta, batch.packed, seq=seq, epoch=epoch
+            conn, wire.KIND_SLOT, meta, payload, seq=seq, epoch=epoch,
+            flags=flags,
         )
+        if payload is not None:
+            # pace BEFORE the bandwidth observation so the EWMA sees
+            # the throttled (bench) link, not the raw loopback burst
+            plane.throttle.pace(sent)
+            plane.comp.observe_send(sent, time.monotonic() - t0)
         self.slots_served += 1
-        self.bytes_served += sent
+        self.bytes_served += raw_n
         _SLOTS.inc()
-        _BYTES.inc(sent)
+        _BYTES.inc(raw_n)
         if self._kill_after and self.slots_served >= self._kill_after:
             os._exit(9)  # chaos drill: die mid-stream, no cleanup
         return seq + 1
 
-    def _stream_static(self, conn, cfg: _StreamConfig) -> None:
+    def _stream_static(
+        self, conn, cfg: _StreamConfig, plane: _DataPlane
+    ) -> None:
         """Tracker-less stripe: the deterministic whole-stripe stream,
         resumable at any slot via HELLO.start_seq."""
         producer = cfg.make_producer(cfg.part, cfg.nparts)
@@ -421,7 +883,7 @@ class DsServeServer:
                 "dmlc:dsserve_stream_shard", shard=cfg.part, mode="static"
             ):
                 seq = self._send_slots(
-                    conn, producer, cfg.part, cfg.epoch, 0,
+                    conn, producer, cfg.part, cfg.epoch, 0, plane,
                     skip=cfg.start_seq,
                 )
             self.shards_streamed += 1
@@ -436,7 +898,9 @@ class DsServeServer:
         finally:
             producer.close()
 
-    def _stream_leased(self, conn, cfg: _StreamConfig) -> None:
+    def _stream_leased(
+        self, conn, cfg: _StreamConfig, plane: _DataPlane
+    ) -> None:
         """PR-10 leaseholder loop: lease → produce → stream → SHARD_FIN
         until the epoch's ledger drains. The client commits dones; this
         side only keeps its leases renewed while it streams.
@@ -546,7 +1010,9 @@ class DsServeServer:
                     _k, shard, batch = item
                     self._tick_depth(-1)
                     sent += 1
-                    seq = self._send_one(conn, batch, shard, epoch, seq)
+                    seq = self._send_one(
+                        conn, batch, shard, epoch, seq, plane
+                    )
                     self._maybe_renew(lease_client, epoch, state)
                 elif kind == "fin":
                     _k, shard, num_shards = item
@@ -607,6 +1073,7 @@ class DsServeServer:
             "slots_served": self.slots_served,
             "bytes_served": self.bytes_served,
             "shards_streamed": self.shards_streamed,
+            "shm_slots_sent": self.shm_slots_sent,
             "queue_depth": self._depth,
             "rank": self.rank,
             "port": self.port,

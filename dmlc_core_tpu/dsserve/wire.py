@@ -33,11 +33,14 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from ..io.codec import get_codec
 from ..staging.batcher import Batch
 from ..staging.pipeline import packed_layout
+from ..telemetry import default_registry as _default_registry
 from ..utils.logging import Error
 
 __all__ = [
+    "FLAG_COMPRESSED",
     "HDR_BYTES",
     "KIND_EPOCH_END",
     "KIND_ERROR",
@@ -47,7 +50,10 @@ __all__ = [
     "KIND_SLOT",
     "MAX_META",
     "MAX_PAYLOAD",
+    "SHM_MAGIC",
     "read_batch",
+    "read_frame_into",
+    "recv_alloc_bytes",
     "recv_frame",
     "send_frame",
     "slot_meta",
@@ -73,21 +79,67 @@ MAX_META = 1 << 20
 #: one packed slot; mirrors the collective engine's 2 GiB frame cap
 MAX_PAYLOAD = (1 << 31) - 1
 
+#: header ``flags`` bit 0: the payload is codec-compressed; meta then
+#: carries ``codec`` (registry name) + ``raw_len`` (decoded bytes) and
+#: the crc covers the WIRE bytes (checked before the decode spends CPU)
+FLAG_COMPRESSED = 0x1
 
-def _recv_exact_into(sock, view: memoryview) -> None:
-    """Fill ``view`` from the socket or raise ConnectionError."""
+#: written at the head of the server's shm PROBE segment; the client
+#: proving it can map and read these bytes back (then confirming in an
+#: OK frame) is what upgrades a stream to the same-host transport —
+#: protocol constant, so it lives with the frame format
+SHM_MAGIC = b"DSSHM1\r\n"
+
+_REG = _default_registry()
+#: receive-side data-plane accounting (docs/observability.md): wire
+#: bytes as sent vs raw slot bytes after decode — their ratio is the
+#: live compression win — and payload-path allocations, which stay 0
+#: while every slot lands in a pooled recv buffer
+_BYTES_WIRE = _REG.counter(
+    "dsserve.bytes_wire", help="dsserve SLOT payload bytes on the wire"
+)
+_BYTES_RAW = _REG.counter(
+    "dsserve.bytes_raw", help="dsserve SLOT payload bytes after decode"
+)
+_RECV_ALLOC = _REG.counter(
+    "dsserve.recv_alloc_bytes",
+    help="dsserve payload bytes received into fresh allocations "
+    "(0 on the pooled recv-into fast path)",
+)
+
+
+def _recv_exact_into(sock, view: memoryview, region: str) -> None:
+    """Fill ``view`` from the socket. EOF mid-fill raises the checked
+    truncation ``Error`` naming the frame region — a peer that dies
+    between frames closes cleanly at a header boundary; one that dies
+    INSIDE a frame leaves bytes the stream can never resynchronize
+    past, and every caller must treat the connection as faulted."""
     got = 0
     n = len(view)
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
-            raise ConnectionError("dsserve peer closed mid-frame")
+            raise Error(
+                f"dsserve: truncated frame {region} "
+                f"(peer closed after {got} of {n} bytes)"
+            )
         got += r
 
 
-def _recv_exact(sock, n: int) -> bytearray:
+def _recv_header(sock, view: memoryview) -> bool:
+    """Fill the 32-byte header view; False on a CLEAN close (EOF before
+    the first byte — the one EOF that is not a truncation)."""
+    r = sock.recv_into(view, HDR_BYTES)
+    if r == 0:
+        return False
+    if r < HDR_BYTES:
+        _recv_exact_into(sock, view[r:], "header")
+    return True
+
+
+def _recv_exact(sock, n: int, region: str) -> bytearray:
     buf = bytearray(n)
-    _recv_exact_into(sock, memoryview(buf))
+    _recv_exact_into(sock, memoryview(buf), region)
     return buf
 
 
@@ -98,6 +150,7 @@ def send_frame(
     payload=None,
     seq: int = 0,
     epoch: int = 0,
+    flags: int = 0,
 ) -> int:
     """Write one frame; returns payload bytes sent. ``payload`` is any
     buffer-protocol object (numpy uint8 views included) sent without an
@@ -116,7 +169,7 @@ def send_frame(
         raise Error(f"dsserve payload too large ({plen} bytes)")
     crc = binascii.crc32(pv) & 0xFFFFFFFF if pv is not None else 0
     hdr = _HDR.pack(
-        MAGIC, kind, 0, 0, int(seq), int(epoch), len(mb), plen, crc
+        MAGIC, kind, flags, 0, int(seq), int(epoch), len(mb), plen, crc
     )
     sock.sendall(hdr + mb)
     if pv is not None and plen:
@@ -124,17 +177,25 @@ def send_frame(
     return plen
 
 
-def recv_frame(sock) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
-    """Read one frame → (kind, meta, payload, seq, epoch).
-
-    The payload lands in a freshly allocated uint8 array via
-    ``recv_into`` — one kernel→user copy, zero further copies before
-    the staging pipeline's dispatch-ring pack. Bad magic, hostile
-    lengths and crc mismatches raise ``Error`` (the connection is
+def _read_frame(
+    sock, buf=None
+) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
+    """The one frame reader (recv_frame and read_frame_into both land
+    here). With ``buf`` (any writable buffer-protocol object) the
+    payload arrives via ``recv_into`` directly in ``buf``'s first bytes
+    and the returned payload is a zero-copy uint8 view over it; without
+    (or when the slot outgrows it) a fresh array is allocated and
+    ticked on ``dsserve.recv_alloc_bytes``. Compressed payloads
+    (FLAG_COMPRESSED) are crc-checked on the wire bytes, decoded
+    through io/codec.py, and land decoded in ``buf`` — bit-identical
+    to the uncompressed path. Bad magic, hostile lengths, crc
+    mismatches and mid-frame EOFs raise ``Error`` (the connection is
     unusable from that byte on — callers drop it and re-enter their
     reconnect path)."""
-    hdr = _recv_exact(sock, HDR_BYTES)
-    magic, kind, _flags, _rsv, seq, epoch, mlen, plen, crc = _HDR.unpack(
+    hdr = bytearray(HDR_BYTES)
+    if not _recv_header(sock, memoryview(hdr)):
+        raise ConnectionError("dsserve peer closed")
+    magic, kind, flags, _rsv, seq, epoch, mlen, plen, crc = _HDR.unpack(
         bytes(hdr)
     )
     if magic != MAGIC:
@@ -146,21 +207,108 @@ def recv_frame(sock) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
     meta: Dict = {}
     if mlen:
         try:
-            meta = json.loads(bytes(_recv_exact(sock, mlen)))
+            meta = json.loads(bytes(_recv_exact(sock, mlen, "meta")))
         except ValueError as e:
             raise Error(f"dsserve: undecodable frame meta: {e}") from e
         if not isinstance(meta, dict):
             raise Error("dsserve: frame meta must be a JSON object")
     payload = None
     if plen:
-        payload = np.empty(plen, dtype=np.uint8)
-        _recv_exact_into(sock, memoryview(payload))
-        got = binascii.crc32(memoryview(payload)) & 0xFFFFFFFF
-        if got != crc:
-            raise Error(
-                f"dsserve: slot crc mismatch (got {got:#x}, want {crc:#x})"
-            )
+        if flags & FLAG_COMPRESSED:
+            payload = _recv_compressed(sock, meta, plen, crc, buf)
+        else:
+            payload = _recv_payload_into(sock, plen, buf)
+            got = binascii.crc32(memoryview(payload)) & 0xFFFFFFFF
+            if got != crc:
+                raise Error(
+                    f"dsserve: slot crc mismatch "
+                    f"(got {got:#x}, want {crc:#x})"
+                )
+        if kind == KIND_SLOT:
+            _BYTES_WIRE.inc(plen)
+            _BYTES_RAW.inc(payload.nbytes)
     return kind, meta, payload, seq, epoch
+
+
+def _recv_payload_into(sock, plen: int, buf) -> np.ndarray:
+    """plen wire bytes → a uint8 array: ``buf``'s head when it fits
+    (zero allocations), else a fresh array (ticked)."""
+    if buf is not None:
+        view = memoryview(buf).cast("B")
+        if len(view) >= plen:
+            _recv_exact_into(sock, view[:plen], "payload")
+            if isinstance(buf, np.ndarray):
+                # slice, don't re-wrap: the view's .base collapses to
+                # ``buf`` itself, so a pool tracking buf's liveness
+                # (weakref.finalize) sees every downstream alias
+                return buf[:plen]
+            return np.frombuffer(buf, dtype=np.uint8, count=plen)
+    _RECV_ALLOC.inc(plen)
+    out = np.empty(plen, dtype=np.uint8)
+    _recv_exact_into(sock, memoryview(out), "payload")
+    return out
+
+
+def _recv_compressed(sock, meta: Dict, plen: int, crc: int, buf):
+    """Receive + decode a FLAG_COMPRESSED payload. The compressed wire
+    bytes and the codec's decode output are both unavoidable
+    allocations (ticked honestly) — the pooled buffer still saves the
+    final resting copy when the decoded slot fits."""
+    try:
+        codec = get_codec(str(meta["codec"]))
+        raw_len = int(meta["raw_len"])
+    except (KeyError, TypeError, ValueError, Error) as e:
+        raise Error(f"dsserve: bad compressed-slot meta: {e}") from e
+    if raw_len < 0 or raw_len > MAX_PAYLOAD:
+        raise Error(f"dsserve: hostile raw_len {raw_len}")
+    wire_bytes = _recv_exact(sock, plen, "payload")
+    got = binascii.crc32(memoryview(wire_bytes)) & 0xFFFFFFFF
+    if got != crc:
+        raise Error(
+            f"dsserve: slot crc mismatch (got {got:#x}, want {crc:#x})"
+        )
+    _RECV_ALLOC.inc(plen + raw_len)
+    raw = codec.decompress(wire_bytes, raw_len)
+    if len(raw) != raw_len:
+        raise Error(
+            f"dsserve: compressed slot decoded to {len(raw)} bytes, "
+            f"meta promised {raw_len}"
+        )
+    if buf is not None:
+        view = memoryview(buf).cast("B")
+        if len(view) >= raw_len:
+            view[:raw_len] = raw
+            if isinstance(buf, np.ndarray):
+                return buf[:raw_len]  # see _recv_payload_into
+            return np.frombuffer(buf, dtype=np.uint8, count=raw_len)
+    return np.frombuffer(bytearray(raw), dtype=np.uint8)
+
+
+def recv_alloc_bytes() -> int:
+    """Process-wide fresh-allocation bytes on the payload receive path
+    — the bench/regression assertion surface: the delta over a drain
+    stays 0 while every slot lands in a pooled recv buffer."""
+    return int(_RECV_ALLOC.value())
+
+
+def recv_frame(sock) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
+    """Read one frame → (kind, meta, payload, seq, epoch); the payload
+    lands in a freshly allocated uint8 array. Control-frame and
+    test-path reader — the hot slot path is :func:`read_frame_into`."""
+    return _read_frame(sock, None)
+
+
+def read_frame_into(
+    sock, buf
+) -> Tuple[int, Dict, Optional[np.ndarray], int, int]:
+    """Read one frame with the payload landing directly in ``buf`` (a
+    writable buffer-protocol object, typically a pooled page-aligned
+    slot) via ``recv_into`` — the zero-copy receive path: no payload
+    allocation, and the returned payload is a uint8 view over ``buf``
+    the caller's ``read_batch`` sections alias in place. Falls back to
+    a fresh allocation (ticked on ``dsserve.recv_alloc_bytes``) when
+    ``buf`` is too small for the slot."""
+    return _read_frame(sock, buf)
 
 
 # -- packed-slot (de)serialization --------------------------------------------

@@ -14,9 +14,10 @@ blocks to any number of client processes.
 Architecture
 ------------
 - **Data plane: named shared memory.** Every cached block lives in one
-  named POSIX shared-memory segment (``_ShmSegment``, the primitive
-  under ``multiprocessing.shared_memory`` without its resource-tracker
-  coupling), so a cache hit is a zero-copy mapped view of the decoded
+  named POSIX shared-memory segment (``io.shm.ShmSegment``, the
+  primitive under ``multiprocessing.shared_memory`` without its
+  resource-tracker coupling — shared with the dsserve same-host
+  transport), so a cache hit is a zero-copy mapped view of the decoded
   bytes — the socket never carries payload.
   ``BlockCacheClient.get_view`` hands out the leased mapping itself;
   ``get`` copies out of it (one memcpy at RAM speed, still no decode
@@ -66,8 +67,9 @@ registry (served on ``/metrics`` when ``metrics_port`` is given), and
 each client mirrors its own hits/misses/publishes/bytes_from_cache so
 per-process exporters show the shared-tier win.
 
-Lint L010 makes this file the ONLY shared-memory / raw ``socket`` site
-inside ``dmlc_core_tpu/io/`` — the same single-site pattern as L006
+Lint L010 makes this file (with io/lookup.py) the only raw ``socket``
+site inside ``dmlc_core_tpu/io/``; segment construction itself lives in
+``io/shm.py`` (lint L019) — the same single-site pattern as L006
 (urlopen), L008 (time.time), L009 (compression).
 
 CLI: ``python -m dmlc_core_tpu.tools cached serve|stats|flush`` —
@@ -80,7 +82,6 @@ from __future__ import annotations
 import itertools
 import json
 import logging
-import mmap
 import os
 import socket
 import struct
@@ -90,16 +91,11 @@ import weakref
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
-try:  # CPython's POSIX shared-memory primitive (what the stdlib's
-    # multiprocessing.shared_memory wraps); absent on non-POSIX builds
-    import _posixshmem
-except ImportError:  # pragma: no cover - non-POSIX platform
-    _posixshmem = None
-
 from ..telemetry import default_registry as _default_registry
 from ..telemetry import tracing as _tracing
 from ..utils.env import get_env
 from ..utils.logging import Error, check
+from .shm import ShmSegment as _ShmSegment
 
 __all__ = [
     "BlockCacheClient",
@@ -177,48 +173,6 @@ def _recv_frame(sock: socket.socket) -> dict:
     if n > MAX_FRAME:
         raise ConnectionError(f"oversized control frame ({n} bytes)")
     return json.loads(_recv_all(sock, n).decode())
-
-
-class _ShmSegment:
-    """Named POSIX shared-memory segment with EXPLICIT lifecycle —
-    deliberately built on ``_posixshmem`` + ``mmap`` rather than
-    ``multiprocessing.shared_memory``: the stdlib's resource tracker
-    registers every open (create AND attach, bpo-39959; opt-out only
-    lands in 3.13) for unlink-at-process-exit, which would tear
-    daemon-owned segments down the moment ONE client exits, its
-    set-based bookkeeping double-removes when daemon and client share
-    a process, and suppressing it means mutating process-global tracker
-    hooks under unrelated threads. Same syscalls, zero tracker
-    interaction; lifecycle here is explicit — the daemon unlinks on
-    eviction/flush/close, a losing publisher unlinks its own copy. The
-    cost is that a SIGKILL'd daemon leaks its segments until `cached
-    flush`/reboot — the standard trade for any shm service."""
-
-    __slots__ = ("name", "buf", "_mmap")
-
-    def __init__(self, name: str, create: bool = False,
-                 size: int = 0) -> None:
-        if _posixshmem is None:  # pragma: no cover - non-POSIX
-            raise OSError("POSIX shared memory unavailable on this host")
-        flags = os.O_RDWR | ((os.O_CREAT | os.O_EXCL) if create else 0)
-        fd = _posixshmem.shm_open("/" + name, flags, mode=0o600)
-        try:
-            if create and size:
-                os.ftruncate(fd, size)
-            self._mmap = mmap.mmap(fd, os.fstat(fd).st_size)
-        finally:
-            os.close(fd)
-        self.name = name
-        self.buf: memoryview = memoryview(self._mmap)
-
-    def close(self) -> None:
-        """Unmap; raises BufferError while exported views are alive
-        (callers guard — the mapping then lives until those views go)."""
-        self.buf.release()
-        self._mmap.close()
-
-    def unlink(self) -> None:
-        _posixshmem.shm_unlink("/" + self.name)
 
 
 # -- daemon -------------------------------------------------------------------

@@ -76,6 +76,16 @@ _REG = _default_registry()
 _ROWS_STAGED = _REG.counter("staging.rows", help="rows staged to device")
 _BYTES_STAGED = _REG.counter("staging.bytes", help="bytes staged to device")
 _DEVICE_PUTS = _REG.counter("staging.device_puts", help="device transfers")
+_SLOTS_ADOPTED = _REG.counter(
+    "staging.adopted_slots",
+    help="packed slots device_put straight from the producer's buffer "
+    "(dispatch_pack copy skipped)",
+)
+_SLOT_COPIES = _REG.counter(
+    "dsserve.slot_copies",
+    help="received dsserve slots that took the dispatch_pack memcpy "
+    "anyway (0 on the zero-copy adopt path)",
+)
 _UNPACK_EVICT = _REG.counter(
     "staging.unpack_evictions", help="jitted-unpacker LRU evictions"
 )
@@ -439,6 +449,37 @@ def _shard_plan(batch: Batch, mesh, data_axis: str):
     return shard_entries, stride, n_shards
 
 
+def _adopt_enabled() -> bool:
+    """``DMLC_STAGING_ADOPT`` gate (default on): off forces the
+    dispatch_pack copy even for adopt-capable producers — the A/B lever
+    for the zero-copy receive benches."""
+    return os.environ.get("DMLC_STAGING_ADOPT", "on").strip().lower() not in (
+        "off", "0", "false", "no",
+    )
+
+
+def adoptable_slot(batch: Batch) -> bool:
+    """True when this batch's packed buffer can be ``device_put``
+    directly, skipping the dispatch_pack memcpy. Callers first check
+    the producer declared ``adopt_slots`` — the promise that a
+    delivered buffer is stable until every view over it dies (dsserve's
+    pooled recv banks and shm segments are liveness-tracked by
+    finalizers). Per batch all that remains is shape: page-aligned and
+    contiguous, so the accelerator path sees the same DMA-friendly
+    source a ring slot would give it. The CPU client zero-copy ALIASES
+    the buffer for the device array's lifetime but also holds a
+    reference to it, which composes with liveness-tracked sources —
+    an adopted bank cannot be recycled (hence rewritten) while the
+    device array lives, unlike the untracked ``_SlotBuf`` ring that
+    must hand CPU fresh memory."""
+    packed = batch.packed
+    return (
+        packed is not None
+        and packed.flags.c_contiguous
+        and packed.ctypes.data % _PAGE == 0
+    )
+
+
 def _pack_single(batch: Batch, platform: str, slot: Optional[_SlotBuf]):
     """Copy ``batch.packed`` once into a stable aligned source; the
     producer's ring slot is recyclable the moment this returns."""
@@ -669,6 +710,14 @@ class StagingPipeline:
         self.rows_staged = 0
         self.batches_staged = 0
         self.bytes_staged = 0
+        # zero-copy slot adoption: only when the producer PROMISES its
+        # packed buffers stay stable until every view dies (dsserve's
+        # pooled/shm recv banks — see DsServeBatches.adopt_slots); ring
+        # producers recycle eagerly and must keep taking the pack copy
+        self._adopt = bool(
+            getattr(host_batches, "adopt_slots", False)
+        ) and _adopt_enabled()
+        self.slots_adopted = 0
         # sticky flag set by close() when a bounded teardown join timed
         # out: an orphaned producer thread may still be reading the host
         # batch source, so callers must defer tearing down mmap-backed
@@ -823,19 +872,48 @@ class StagingPipeline:
                     slot.pending = item
                 self._observe("dispatch_put", get_time() - t0, dispatch=True)
             elif layout is not None:
-                slot = self._next_slot()
-                t0 = get_time()
-                with annotate("dmlc:dispatch_pack"):
-                    src = _pack_single(host, platform, slot)
-                self._observe("dispatch_pack", get_time() - t0, dispatch=True)
-                t0 = get_time()
-                with annotate("dmlc:dispatch_put"):
-                    item = self._exec.submit(
-                        _put_packed, src, layout, self._device, self.staging
+                if self._adopt and adoptable_slot(host):
+                    # zero-copy adopt: device_put straight from the
+                    # producer's page-aligned buffer. No ring slot and
+                    # no slot.pending — the submitted future holds the
+                    # source array, and on CPU jax's zero-copy alias
+                    # additionally pins it for the device array's life,
+                    # so the producer's finalizer-based recycling can't
+                    # fire under an in-flight transfer.
+                    t0 = get_time()
+                    with annotate("dmlc:dispatch_put"):
+                        item = self._exec.submit(
+                            _put_packed, host.packed, layout, self._device,
+                            self.staging,
+                        )
+                    self.slots_adopted += 1
+                    _SLOTS_ADOPTED.inc()
+                    self._observe(
+                        "dispatch_put", get_time() - t0, dispatch=True
                     )
-                if platform != "cpu":
-                    slot.pending = item
-                self._observe("dispatch_put", get_time() - t0, dispatch=True)
+                else:
+                    if self._adopt:
+                        # adopt-capable producer but this buffer failed
+                        # the shape check (unaligned fallback alloc)
+                        _SLOT_COPIES.inc()
+                    slot = self._next_slot()
+                    t0 = get_time()
+                    with annotate("dmlc:dispatch_pack"):
+                        src = _pack_single(host, platform, slot)
+                    self._observe(
+                        "dispatch_pack", get_time() - t0, dispatch=True
+                    )
+                    t0 = get_time()
+                    with annotate("dmlc:dispatch_put"):
+                        item = self._exec.submit(
+                            _put_packed, src, layout, self._device,
+                            self.staging,
+                        )
+                    if platform != "cpu":
+                        slot.pending = item
+                    self._observe(
+                        "dispatch_put", get_time() - t0, dispatch=True
+                    )
             else:
                 # per-array fallback: host buffers stay referenced until
                 # the DMA completes, so dispatch stays on this thread and
@@ -943,6 +1021,7 @@ class StagingPipeline:
         out = self.staging.snapshot()
         out["dispatch_ring_depth"] = self._depth
         out["dispatch_ring_slots"] = len(self._slots)
+        out["slots_adopted"] = self.slots_adopted
         return out
 
     def io_stats(self) -> Dict[str, Any]:

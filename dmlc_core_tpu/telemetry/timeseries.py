@@ -428,6 +428,17 @@ def _derive(
     slots = _rate(counters, "dsserve.slots_served")
     if slots:
         out["dsserve_slots_per_sec"] = round(slots, 2)
+    # data-plane efficiency: wire ratio < 1.0 means the adaptive codec
+    # is winning (bytes on the wire per raw payload byte); shm_frac is
+    # the slice of slots that skipped TCP entirely via shared memory
+    wire = _rate(counters, "dsserve.bytes_wire")
+    raw = _rate(counters, "dsserve.bytes_raw")
+    if raw > 0:
+        out["dsserve_wire_ratio"] = round(wire / raw, 4)
+    shm = _rate(counters, "dsserve.shm_slots")
+    tcp = _rate(counters, "dsserve.tcp_slots")
+    if shm + tcp > 0:
+        out["dsserve_shm_frac"] = round(shm / (shm + tcp), 4)
     qd = gauges.get("tracker.shards.queue_depth")
     if qd is not None:
         out["shard_queue_depth"] = qd
@@ -487,7 +498,12 @@ def merge_windows(views: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
         )
         for stage, f in (d.get("stall_fraction") or {}).items():
             stall.setdefault(stage, []).append(f)
-        for k in ("block_cache_hit_rate", "decode_cache_hit_rate"):
+        for k in (
+            "block_cache_hit_rate",
+            "decode_cache_hit_rate",
+            "dsserve_wire_ratio",
+            "dsserve_shm_frac",
+        ):
             if k in d:
                 fracs.setdefault(k, []).append(d[k])
         for k in ("lookup_qps", "dsserve_slots_per_sec"):

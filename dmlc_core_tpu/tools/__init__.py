@@ -746,6 +746,8 @@ def _top_model(report: dict, window: float) -> dict:
                     "lookup_qps",
                     "lookup_p99_ms",
                     "dsserve_slots_per_sec",
+                    "dsserve_wire_ratio",
+                    "dsserve_shm_frac",
                     "shard_queue_depth",
                 )
                 if k in d
@@ -811,7 +813,12 @@ def _render_top(model: dict, endpoint: str) -> str:
             + (f" p99 {p99:g}ms" if p99 is not None else "")
         )
     if "dsserve_slots_per_sec" in cd:
-        summary.append(f"dsserve {cd['dsserve_slots_per_sec']:g} slots/s")
+        dss = f"dsserve {cd['dsserve_slots_per_sec']:g} slots/s"
+        if "dsserve_wire_ratio" in cd:
+            dss += f" wire {cd['dsserve_wire_ratio'] * 100:.0f}%"
+        if "dsserve_shm_frac" in cd:
+            dss += f" shm {cd['dsserve_shm_frac'] * 100:.0f}%"
+        summary.append(dss)
     lines.append("  ".join(summary))
     asc = model.get("autoscale")
     if asc:
@@ -846,6 +853,15 @@ def _render_top(model: dict, endpoint: str) -> str:
             for stage, frac in stalls
             if frac > 0
         )
+        # data-plane mix for ranks draining dsserve: wire bytes per raw
+        # byte (codec win when < 100%) and the shm/tcp slot split
+        extras = []
+        if "dsserve_wire_ratio" in r:
+            extras.append(f"wire {r['dsserve_wire_ratio'] * 100:.0f}%")
+        if "dsserve_shm_frac" in r:
+            extras.append(f"shm {r['dsserve_shm_frac'] * 100:.0f}%")
+        if extras:
+            stall_txt = "  ".join(filter(None, [stall_txt, *extras]))
         lines.append(
             f"{rank:>8}  {_fmt_rate(r.get('rows_per_sec', 0.0)):>10}  "
             f"{stall_txt}"

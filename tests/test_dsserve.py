@@ -833,6 +833,270 @@ def test_inprocess_retire_mid_drain_exactly_once(corpus, tracker):
     assert summary["duplicates"] == 0
 
 
+# -- zero-copy data plane ------------------------------------------------------
+
+
+def test_wire_drip_feed_truncation_walk():
+    """Short-read hardening: EOF at EVERY byte boundary of a SLOT frame
+    raises the checked truncation Error naming the starved region
+    (header / meta / payload) — never a hang, never a silent partial
+    frame. The walk drip-feeds every prefix of a real frame."""
+    import struct  # test-side header parsing (L015 scopes library code)
+
+    payload = np.arange(48, dtype=np.uint8)
+    with _Pipe() as (a, b):
+        wire.send_frame(a, wire.KIND_SLOT, {"shard": 1}, payload, seq=2)
+        frame = b""
+        b.settimeout(5)
+        while True:
+            try:
+                chunk = b.recv(4096)
+            except socket.timeout:
+                break
+            if not chunk:
+                break
+            frame += chunk
+            if len(frame) >= wire.HDR_BYTES + 11 + payload.nbytes:
+                break
+    mlen = struct.unpack("<IBBHqiIII", frame[: wire.HDR_BYTES])[6]
+    assert len(frame) == wire.HDR_BYTES + mlen + payload.nbytes
+    for cut in range(len(frame)):
+        a2, b2 = socket.socketpair()
+        try:
+            a2.sendall(frame[:cut])
+            a2.close()
+            if cut == 0:
+                # EOF before byte one is the CLEAN close, not truncation
+                with pytest.raises(ConnectionError):
+                    wire.recv_frame(b2)
+                continue
+            region = (
+                "header"
+                if cut < wire.HDR_BYTES
+                else "meta"
+                if cut < wire.HDR_BYTES + mlen
+                else "payload"
+            )
+            with pytest.raises(Error, match=f"truncated frame {region}"):
+                wire.recv_frame(b2)
+        finally:
+            b2.close()
+    # the pooled recv-into reader shares the hardened path
+    buf = np.zeros(64, dtype=np.uint8)
+    a2, b2 = socket.socketpair()
+    try:
+        a2.sendall(frame[: wire.HDR_BYTES + mlen + 10])
+        a2.close()
+        with pytest.raises(Error, match="truncated frame payload"):
+            wire.read_frame_into(b2, buf)
+    finally:
+        b2.close()
+
+
+def test_slot_pool_reuse_under_live_views():
+    """_SlotPool's liveness contract: a bank is re-banked only when the
+    LAST view over its carve dies — a lease-buffered batch's bytes can
+    never be overwritten by pool churn — and growth retires undersized
+    banks instead of handing them out again."""
+    import gc
+
+    from dmlc_core_tpu.dsserve.client import _SlotPool
+
+    pool = _SlotPool()
+    assert pool.get() is None  # unsized: caller takes the alloc reader
+    pool.ensure(1 << 12)
+    a = pool.get()
+    assert a.nbytes == 1 << 12
+    assert a.ctypes.data % 4096 == 0  # page-aligned carve
+    b = pool.get()
+    assert pool.banks == 2
+    a[:] = 7
+    view = a[100:200]  # read_batch-style section alias
+    del a
+    gc.collect()
+    c = pool.get()  # first bank still aliased by `view`: must be fresh
+    assert pool.banks == 3
+    c[:] = 9
+    assert (view == 7).all()  # held bytes survive pool churn
+    del view
+    gc.collect()
+    d = pool.get()  # the first bank finally recycled: no new bank
+    assert pool.banks == 3
+    pool.ensure(1 << 13)
+    e = pool.get()
+    assert e.nbytes == 1 << 13
+    assert pool.banks == 4
+    del b, c, d  # undersized banks retire through their finalizers
+    gc.collect()
+    assert pool.banks == 1
+    del e
+
+
+def test_adoptable_slot_predicate():
+    """Shape gate for the staging pipeline's zero-copy adoption: dense
+    page-aligned packed buffers qualify; unaligned, strided or
+    packed-less batches take the dispatch_pack copy."""
+    from dmlc_core_tpu.staging.batcher import Batch
+    from dmlc_core_tpu.staging.pipeline import adoptable_slot
+
+    mem = bytearray((1 << 13) + 4096)
+    whole = np.frombuffer(mem, dtype=np.uint8)
+    off = (-whole.ctypes.data) % 4096
+    aligned = np.frombuffer(mem, dtype=np.uint8, count=1 << 12, offset=off)
+    lab = np.zeros(4, dtype=np.float32)
+
+    def mk(packed):
+        return Batch(labels=lab, weights=lab, n_valid=4, packed=packed)
+
+    assert adoptable_slot(mk(aligned))
+    assert not adoptable_slot(mk(None))
+    unaligned = np.frombuffer(
+        mem, dtype=np.uint8, count=1 << 12, offset=off + 1
+    )
+    assert not adoptable_slot(mk(unaligned))
+    assert not adoptable_slot(mk(aligned[::2]))
+
+
+@pytest.mark.parametrize("transport", ["tcp", "tcp_codec", "shm"])
+@pytest.mark.parametrize("path", ["fused", "generic"])
+def test_transport_matrix_bit_identity(transport, path, corpus, monkeypatch):
+    """The data-plane acceptance matrix: {plain TCP, TCP + adaptive
+    codec (throttled so compression engages), same-host shm} ×
+    {fused, generic} drains are all BIT-IDENTICAL to the local
+    pipeline, and the telemetry proves which transport carried the
+    slots."""
+    rec, idx = corpus
+    spec = _spec(overflow="error" if path == "generic" else "truncate")
+    local = fused.ell_batches(_uri(rec, idx), spec)
+    want = _drain_packed(local)
+    local.close()
+    monkeypatch.setenv(
+        "DMLC_DSSERVE_SHM", "on" if transport == "shm" else "off"
+    )
+    if transport == "tcp_codec":
+        monkeypatch.setenv("DMLC_DSSERVE_WIRE_CODEC", "zlib")
+        # throttle loopback so the measured wire bandwidth makes
+        # compression the winning move (no knob forces it on)
+        monkeypatch.setenv("DMLC_DSSERVE_WIRE_BPS", "1000000")
+    w0 = wire._BYTES_WIRE.value()
+    r0 = wire._BYTES_RAW.value()
+    srv = DsServeServer().start()
+    try:
+        c = DsServeBatches(
+            f"dsserve://127.0.0.1:{srv.port}{_uri(rec, idx)}", spec,
+            mode="static",
+        )
+        got = _drain_packed(c)
+        stats = c.io_stats()
+        c.close()
+    finally:
+        srv.close()
+    assert got == want
+    slots = stats["slots"]
+    if transport == "shm":
+        assert stats["shm_slots"] >= 1  # the ring actually carried slots
+        assert stats["shm_slots"] + stats["tcp_slots"] == slots
+        assert srv.shm_slots_sent == stats["shm_slots"]
+        assert stats["reconnects"] == 0  # shm never degraded the stream
+    else:
+        assert stats["shm_slots"] == 0
+        assert stats["tcp_slots"] == slots
+    if transport == "tcp_codec":
+        dw = wire._BYTES_WIRE.value() - w0
+        dr = wire._BYTES_RAW.value() - r0
+        assert dr > 0 and dw < dr  # the adaptive codec actually engaged
+
+
+def test_shm_degrade_drill_silent_tcp_fallback(corpus, monkeypatch):
+    """DMLC_DSSERVE_SHM_BREAK_AFTER chaos drill: after N shm slots the
+    server names a never-created segment, the client's shm_open ENOENTs,
+    the endpoint silently degrades to TCP (one reconnect, sticky — no
+    flap) and the resumed stream is bit-identical: exactly-once, zero
+    operator action."""
+    rec, idx = corpus
+    spec = _spec()
+    local = fused.ell_batches(_uri(rec, idx), spec)
+    want = _drain_packed(local)
+    local.close()
+    monkeypatch.setenv("DMLC_DSSERVE_SHM_BREAK_AFTER", "3")
+    # a ring deeper than the client's prefetch queue: no ring-exhausted
+    # TCP fallbacks before the break, so the slot positions are exact
+    monkeypatch.setenv("DMLC_DSSERVE_SHM_SLOTS", "64")
+    srv = DsServeServer().start()
+    try:
+        c = DsServeBatches(
+            f"dsserve://127.0.0.1:{srv.port}{_uri(rec, idx)}", spec,
+            mode="static",
+        )
+        got = _drain_packed(c)
+        stats = c.io_stats()
+        assert c._eps[0].shm_ok is False  # degrade is sticky
+        c.close()
+    finally:
+        srv.close()
+    assert got == want  # bit-identical despite the mid-stream break
+    assert stats["shm_slots"] == 3  # the pre-break shm slots delivered
+    assert stats["tcp_slots"] == want[2] - 3  # the TCP resume tail
+    assert stats["reconnects"] == 1  # one degrade, never a flap loop
+
+
+def test_hold_budget_backpressure_never_drops(corpus, tracker, monkeypatch):
+    """A DMLC_DSSERVE_HOLD_MB budget far below one micro-shard's bytes
+    still drains the epoch exactly-once: the largest holder always
+    proceeds (backpressure, never drop, never a mutual-park deadlock)
+    and the peak gauge records the held bytes."""
+    from dmlc_core_tpu.dsserve.client import _HELD_BYTES
+
+    rec, idx = corpus
+    monkeypatch.setenv("DMLC_DSSERVE_HOLD_MB", "0.01")  # ~10 KB ceiling
+    s1 = DsServeServer(rank=101).start()
+    s2 = DsServeServer(rank=102).start()
+    try:
+        c = DsServeBatches(
+            f"dsserve://127.0.0.1:{s1.port},127.0.0.1:{s2.port}"
+            f"{_uri(rec, idx)}", _spec(), mode="lease",
+        )
+        rows = sum(b.n_valid for b in c)
+        c.close()
+    finally:
+        s1.close()
+        s2.close()
+    summary = tracker.shards.summary()
+    assert rows == N_ROWS
+    assert summary["completed"] == summary["n_shards"]
+    assert summary["duplicates"] == 0
+    assert _HELD_BYTES.value() > 0  # the peak gauge saw held bytes
+
+
+def test_staging_pipeline_adopts_received_slots(corpus):
+    """The tentpole end state: recv → ONE device_put. Every received
+    slot is adopted straight into the transfer (dispatch_pack skipped,
+    ``dsserve.slot_copies`` stays flat) because dsserve's pooled/shm
+    buffers are page-aligned and liveness-tracked."""
+    jax = pytest.importorskip("jax")
+    from dmlc_core_tpu.staging import pipeline as pl
+
+    rec, idx = corpus
+    spec = _spec()
+    copies0 = pl._SLOT_COPIES.value()
+    srv = DsServeServer().start()
+    try:
+        src = DsServeBatches(
+            f"dsserve://127.0.0.1:{srv.port}{_uri(rec, idx)}", spec,
+            mode="static",
+        )
+        pipe = pl.StagingPipeline(src, device=jax.local_devices()[0])
+        n = sum(1 for _ in pipe)
+        stats = pipe.staging_stats()
+        pl.drain_close(pipe, src)
+    finally:
+        srv.close()
+    assert n > 0
+    assert stats["slots_adopted"] == n  # every slot skipped the copy
+    assert stats["packed_batches"] == n
+    assert pl._SLOT_COPIES.value() == copies0
+
+
 def test_client_discovers_endpoints_from_file(
     corpus, tracker, tmp_path, monkeypatch
 ):

@@ -226,25 +226,12 @@ def test_codec_import_quiet_in_codec_layer(tmp_path):
 
 
 def test_shm_socket_import_flagged_in_io(tmp_path):
-    """L010: shared memory + raw sockets inside dmlc_core_tpu/io/ are
-    one layer (io/blockcache.py), mirroring L006/L008/L009."""
+    """L010: raw sockets inside dmlc_core_tpu/io/ are one layer
+    (io/blockcache.py + io/lookup.py), mirroring L006/L008/L009."""
     assert [c for c, _ in _lib_findings(
         "import socket\nsocket.socket()\n", tmp_path)] == ["L010"]
     assert [c for c, _ in _lib_findings(
         "from socket import socket\nsocket()\n", tmp_path)] == ["L010"]
-    assert [c for c, _ in _lib_findings(
-        "import multiprocessing.shared_memory as sm\nsm.SharedMemory\n",
-        tmp_path)] == ["L010"]
-    assert [c for c, _ in _lib_findings(
-        "from multiprocessing import shared_memory\n"
-        "shared_memory.SharedMemory\n", tmp_path)] == ["L010"]
-    assert [c for c, _ in _lib_findings(
-        "from multiprocessing.shared_memory import SharedMemory\n"
-        "SharedMemory\n", tmp_path)] == ["L010"]
-    # the low-level primitive blockcache actually rides is banned too
-    assert [c for c, _ in _lib_findings(
-        "import _posixshmem\n_posixshmem.shm_open\n", tmp_path)
-    ] == ["L010"]
 
 
 def test_shm_socket_quiet_outside_io_and_in_blockcache(tmp_path):
@@ -256,14 +243,11 @@ def test_shm_socket_quiet_outside_io_and_in_blockcache(tmp_path):
     f = d / "protocol.py"
     f.write_text("import socket\nsocket.socket()\n")
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
-    # io/blockcache.py owns the single site and is exempt
+    # io/blockcache.py owns the control-plane socket and is exempt
     d = tmp_path / "dmlc_core_tpu" / "io"
     d.mkdir(parents=True)
     f = d / "blockcache.py"
-    f.write_text(
-        "import socket\nfrom multiprocessing import shared_memory\n"
-        "socket.socket(); shared_memory.SharedMemory\n"
-    )
+    f.write_text("import socket\nsocket.socket()\n")
     assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
     # plain multiprocessing (pools, queues) is NOT the rule's business
     assert _lib_findings(
@@ -274,6 +258,62 @@ def test_shm_socket_quiet_outside_io_and_in_blockcache(tmp_path):
         "import socket  # noqa: L010 (exception classification)\n"
         "socket.timeout\n", tmp_path
     ) == []
+
+
+def test_shm_segment_construction_flagged_library_wide(tmp_path):
+    """L019: shm segment construction is one module (io/shm.py's
+    ShmSegment) across the WHOLE library — imports of the primitives
+    and alias-aware shm_open/shm_unlink/SharedMemory calls both flag."""
+    assert [c for c, _ in _lib_findings(
+        "import _posixshmem\n_posixshmem.shm_open\n", tmp_path)
+    ] == ["L019"]
+    assert [c for c, _ in _lib_findings(
+        "import multiprocessing.shared_memory as sm\nsm.SharedMemory\n",
+        tmp_path)] == ["L019"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing import shared_memory\n"
+        "shared_memory.SharedMemory\n", tmp_path)] == ["L019"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing.shared_memory import SharedMemory\n"
+        "SharedMemory\n", tmp_path)] == ["L019"]
+    # a CALL through an alias flags the call site too — alias games
+    # don't dodge the rule (the L014/L015 pattern)
+    assert [c for c, _ in _lib_findings(
+        "import _posixshmem as p\np.shm_open('/x', 0)\n", tmp_path)
+    ] == ["L019", "L019"]
+    assert [c for c, _ in _lib_findings(
+        "from multiprocessing.shared_memory import SharedMemory as SM\n"
+        "SM(name='x')\n", tmp_path)] == ["L019", "L019"]
+    # the rule covers the whole library, not just io/ — a tracker
+    # module minting segments forks the lifecycle policy all the same
+    d = tmp_path / "dmlc_core_tpu" / "tracker"
+    d.mkdir(parents=True)
+    f = d / "ledger.py"
+    f.write_text("import _posixshmem\n_posixshmem.shm_open('/x', 0)\n")
+    assert [c for (_, _, c, _) in lint.lint_file(f)] == ["L019", "L019"]
+
+
+def test_shm_segment_construction_quiet_in_shm_and_outside(tmp_path):
+    # io/shm.py owns the construction site and is exempt
+    d = tmp_path / "dmlc_core_tpu" / "io"
+    d.mkdir(parents=True)
+    f = d / "shm.py"
+    f.write_text("import _posixshmem\n_posixshmem.shm_open('/x', 0)\n")
+    assert [(c, ln) for (_, ln, c, _) in lint.lint_file(f)] == []
+    # outside the library the rule does not fire (tests build probe
+    # segments; scripts may use the stdlib wrapper)
+    assert codes(
+        "from multiprocessing import shared_memory\n"
+        "shared_memory.SharedMemory(name='x')\n", tmp_path) == []
+    # file-backed mmap is NOT this rule's business (io/split.py,
+    # staging/fused.py map files, not segments)
+    assert _lib_findings(
+        "import mmap\nimport os\n"
+        "m = mmap.mmap(os.open('/f', 0), 0)\n", tmp_path) == []
+    # riding the sanctioned primitive is the blessed route
+    assert _lib_findings(
+        "from dmlc_core_tpu.io.shm import ShmSegment\n"
+        "ShmSegment('x', create=True, size=8)\n", tmp_path) == []
 
 
 def test_trace_event_literal_flagged_in_library(tmp_path):

@@ -195,6 +195,46 @@ def test_cluster_store_rejects_malformed_samples():
     assert store.ranks() == [1]
 
 
+def test_dsserve_data_plane_derivations():
+    """The dashboard's data-plane signals: wire ratio = wire/raw byte
+    rates (codec win when < 1), shm fraction = shm/(shm+tcp) slots."""
+    samples = []
+    for i, (w, r, shm, tcp) in enumerate(
+        ((0.0, 0.0, 0.0, 0.0), (50.0, 100.0, 3.0, 1.0))
+    ):
+        samples.append({
+            "t": 100.0 + i * 10.0, "seq": i + 1,
+            "counters": {
+                "dsserve.bytes_wire": w, "dsserve.bytes_raw": r,
+                "dsserve.shm_slots": shm, "dsserve.tcp_slots": tcp,
+            },
+            "gauges": {}, "histograms": {},
+        })
+    win = ts.windowed(samples, 60.0)
+    assert win["derived"]["dsserve_wire_ratio"] == pytest.approx(0.5)
+    assert win["derived"]["dsserve_shm_frac"] == pytest.approx(0.75)
+
+
+def test_merge_windows_averages_data_plane_fracs():
+    """Wire ratio and shm fraction are per-process fractions: the
+    cluster view averages them over reporting ranks (summing would read
+    as nonsense, the stall-fraction rule)."""
+    views = {
+        str(i): {
+            "samples": 2, "counters": {}, "gauges": {},
+            "derived": {
+                "rows_per_sec": 1.0,
+                "dsserve_wire_ratio": ratio,
+                "dsserve_shm_frac": frac,
+            },
+        }
+        for i, (ratio, frac) in enumerate(((0.4, 1.0), (0.6, 0.5)))
+    }
+    merged = ts.merge_windows(views)
+    assert merged["derived"]["dsserve_wire_ratio"] == pytest.approx(0.5)
+    assert merged["derived"]["dsserve_shm_frac"] == pytest.approx(0.75)
+
+
 def test_merge_windows_sums_rows_and_averages_fractions():
     views = {
         "0": {"samples": 2, "counters": {"io.split.records":

@@ -37,18 +37,32 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  block cache — and is exempt; everything
                                  else compresses through it so telemetry
                                  and import guards can't be bypassed)
-  L010 shared-memory / raw socket import in dmlc_core_tpu/io/ (the
-                                 host-level shared block-cache service
-                                 owns the one shm+socket site:
+  L010 raw socket import in dmlc_core_tpu/io/ (the two sanctioned wire
+                                 services own the socket sites:
                                  io/blockcache.py — control-plane
-                                 framing, segment lifecycle, lease
-                                 bookkeeping — and is exempt; everything
-                                 else in io/ rides its client so the
+                                 framing, lease bookkeeping — and
+                                 io/lookup.py — the point-read serve
+                                 daemon — are exempt; everything else
+                                 in io/ rides their clients so the
                                  fallback semantics and io.blockcache.*
                                  telemetry can't be bypassed. Genuine
                                  non-cache uses — retry.py's socket
                                  exception classification — opt out per
                                  line with `# noqa: L010`.)
+  L019 shared-memory segment construction outside io/shm.py (imports
+                                 of _posixshmem or multiprocessing.
+                                 shared_memory, and alias-aware
+                                 shm_open/shm_unlink/SharedMemory
+                                 calls, anywhere in dmlc_core_tpu/:
+                                 ShmSegment in io/shm.py is the one
+                                 construction site — it owns the
+                                 no-resource-tracker rationale
+                                 (bpo-39959), explicit unlink
+                                 lifecycle and the SIGKILL leak
+                                 trade-off; blockcache and the dsserve
+                                 same-host transport both ride it.
+                                 File-backed mmap — io/split.py,
+                                 staging/fused.py — is out of scope.)
   L011 Chrome trace-event literal in dmlc_core_tpu/ (the flight
                                  recorder owns trace-event emission and
                                  the trace-file format:
@@ -404,10 +418,14 @@ _L006_EXEMPT = ("/io/retry.py",)
 # files allowed to import compression modules directly: the codec layer
 _L009_EXEMPT = ("/io/codec.py",)
 # L010 is SCOPED to dmlc_core_tpu/io/ and exempts the two sanctioned
-# wire services: the block-cache daemon (shm + UNIX socket) and the
-# point-read serve daemon (TCP request loop, io/lookup.py)
+# wire services: the block-cache daemon (UNIX-socket control plane) and
+# the point-read serve daemon (TCP request loop, io/lookup.py)
 _L010_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
 _L010_EXEMPT = ("/io/blockcache.py", "/io/lookup.py")
+# L019 is scoped to the WHOLE library (a shm segment could plausibly be
+# minted anywhere) and exempts the one sanctioned construction site
+_L019_SCOPE_DIRS = ("dmlc_core_tpu/",)
+_L019_EXEMPT = ("/io/shm.py",)
 # L016 is scoped to dmlc_core_tpu/io/ and exempts the same two files —
 # the only modules allowed to RUN a socket-serving request loop there
 _L016_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
@@ -503,42 +521,110 @@ def _check_rendezvous_cmd_literals(tree: ast.Module) -> Iterator[Tuple[int, str]
             )
 
 def _check_shm_socket_imports(tree: ast.Module) -> Iterator[Tuple[int, str]]:
-    """Any import binding the ``socket`` module or
-    ``multiprocessing.shared_memory`` (incl. ``from multiprocessing
-    import shared_memory`` and ``from multiprocessing.shared_memory
-    import SharedMemory``): inside dmlc_core_tpu/io/ the shared
-    block-cache service is one layer (io/blockcache.py — UNIX-socket
-    control plane, shm segment lifecycle, leases, telemetry), mirroring
-    the L006/L008/L009 single-site pattern. Scoped in lint_file."""
+    """Any import binding the ``socket`` module: inside
+    dmlc_core_tpu/io/ the two sanctioned wire services (io/blockcache.py
+    — UNIX-socket control plane — and io/lookup.py — the point-read
+    serve daemon) own cross-process traffic, mirroring the
+    L006/L008/L009 single-site pattern. Shared-memory construction is
+    L019's business (io/shm.py). Scoped in lint_file."""
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for alias in node.names:
-                root = alias.name.partition(".")[0]
-                if root in ("socket", "_posixshmem"):
+                if alias.name.partition(".")[0] == "socket":
                     yield node.lineno, (
-                        "direct socket/_posixshmem import (cross-process "
-                        "cache traffic belongs to io/blockcache.py)"
-                    )
-                elif alias.name.startswith("multiprocessing.shared_memory"):
-                    yield node.lineno, (
-                        "direct shared_memory import (shared segments "
-                        "belong to io/blockcache.py)"
+                        "direct socket import in io/ (cross-process "
+                        "traffic belongs to io/blockcache.py and "
+                        "io/lookup.py)"
                     )
         elif isinstance(node, ast.ImportFrom) and node.level == 0:
             mod = node.module or ""
-            if mod.partition(".")[0] in ("socket", "_posixshmem"):
+            if mod.partition(".")[0] == "socket":
                 yield node.lineno, (
-                    "direct socket/_posixshmem import (cross-process "
-                    "cache traffic belongs to io/blockcache.py)"
+                    "direct socket import in io/ (cross-process "
+                    "traffic belongs to io/blockcache.py and "
+                    "io/lookup.py)"
                 )
+
+
+_SHM_CTORS = ("shm_open", "shm_unlink", "SharedMemory")
+
+
+def _check_shm_segment_construction(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Any import binding ``_posixshmem`` or ``multiprocessing.
+    shared_memory`` (incl. ``from multiprocessing import
+    shared_memory`` / ``from multiprocessing.shared_memory import
+    SharedMemory``), and any call resolving to ``shm_open`` /
+    ``shm_unlink`` / ``SharedMemory`` under any alias: inside
+    dmlc_core_tpu/ shared-memory segment construction is one module —
+    io/shm.py's ShmSegment, which owns the no-resource-tracker
+    rationale (bpo-39959), the explicit create/attach/unlink lifecycle
+    and the leak trade-off — mirroring the L006/L008-L018 single-site
+    pattern. A second construction site forks segment naming and
+    lifecycle policy; blockcache leases and the dsserve same-host
+    transport both ride ShmSegment. File-backed ``mmap`` (io/split.py,
+    staging/fused.py) is NOT this rule's business. Scoped in
+    lint_file."""
+    fn_aliases = set()
+    mod_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.partition(".")[0] == "_posixshmem":
+                    yield node.lineno, (
+                        "direct _posixshmem import (segment construction "
+                        "belongs to io/shm.py's ShmSegment)"
+                    )
+                    mod_aliases.add(alias.asname or "_posixshmem")
+                elif alias.name.startswith("multiprocessing.shared_memory"):
+                    yield node.lineno, (
+                        "direct shared_memory import (shared segments "
+                        "belong to io/shm.py's ShmSegment)"
+                    )
+        elif isinstance(node, ast.ImportFrom) and node.level == 0:
+            mod = node.module or ""
+            if mod.partition(".")[0] == "_posixshmem":
+                yield node.lineno, (
+                    "direct _posixshmem import (segment construction "
+                    "belongs to io/shm.py's ShmSegment)"
+                )
+                for alias in node.names:
+                    if alias.name in _SHM_CTORS:
+                        fn_aliases.add(alias.asname or alias.name)
             elif mod.startswith("multiprocessing.shared_memory") or (
                 mod == "multiprocessing"
                 and any(a.name == "shared_memory" for a in node.names)
             ):
                 yield node.lineno, (
                     "direct shared_memory import (shared segments "
-                    "belong to io/blockcache.py)"
+                    "belong to io/shm.py's ShmSegment)"
                 )
+                for alias in node.names:
+                    if alias.name in _SHM_CTORS:
+                        fn_aliases.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        hit = (
+            isinstance(f, ast.Name)
+            and (f.id in fn_aliases or f.id in ("shm_open", "shm_unlink"))
+        ) or (
+            isinstance(f, ast.Attribute)
+            and (
+                f.attr in ("shm_open", "shm_unlink")
+                or (
+                    f.attr == "SharedMemory"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in mod_aliases | {"shared_memory"}
+                )
+            )
+        )
+        if hit:
+            yield node.lineno, (
+                "shared-memory segment construction outside io/shm.py "
+                "(shm_open/shm_unlink/SharedMemory belong to ShmSegment "
+                "— a second site forks naming and lifecycle policy)"
+            )
 
 
 def _check_trace_event_literals(tree: ast.Module) -> Iterator[Tuple[int, str]]:
@@ -838,6 +924,7 @@ CHECKS = [
     ("L016", _check_socket_serving_loops),
     ("L017", _check_trace_context_codec),
     ("L018", _check_journal_crc_framing),
+    ("L019", _check_shm_segment_construction),
 ]
 
 
@@ -955,6 +1042,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L018_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L018_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L019":
+            if posix.endswith(_L019_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L019_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L019_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
