@@ -2063,6 +2063,100 @@ def _tracker_kill_recovery_bench() -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def _stream_online_bench() -> dict:
+    """The ``stream_online`` config (ISSUE 19 acceptance): a paced
+    generator process appends to a live stream directory while a
+    tail-following trainer drains it through ``StreamSource``. The
+    trainer samples its own staleness (``lag_seconds``) at every batch;
+    the p99 must stay under the pinned bound — the whole point of the
+    manifest watermark is that a follower is never more than a commit
+    cadence behind a healthy writer. Afterwards the sealed directory is
+    drained post-hoc: rows, order and per-generation sha256 must be
+    IDENTICAL to what the live follower saw (tail reads never tear or
+    reorder)."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+
+    from dmlc_core_tpu.stream import StreamSource, StreamWriter
+    from dmlc_core_tpu.stream import manifest as _sm
+
+    n_rows = 4000
+    pace_chunk, pace_sleep = 25, 0.01  # ~2500 rows/s generator
+    lag_bound_p99 = 2.0
+
+    def row(i: int) -> bytes:
+        return (b"online-%07d|" % i) * (1 + i % 3)
+
+    tmpdir = tempfile.mkdtemp(prefix="dmlc_stream_online_")
+    try:
+        def produce():
+            with StreamWriter(
+                tmpdir, codec="zlib", block_bytes=4096,
+                rotate_bytes=8 << 10, commit_records=50,
+            ) as w:
+                for i in range(n_rows):
+                    w.append(row(i))
+                    if i % pace_chunk == pace_chunk - 1:
+                        time.sleep(pace_sleep)
+
+        gen_thread = threading.Thread(target=produce)
+        t0 = time.perf_counter()
+        gen_thread.start()
+        src = StreamSource(tmpdir, poll_secs=0.005, max_idle_secs=60.0)
+        live = []
+        lags = []
+        while True:
+            b = src.next_batch(64)
+            if b is None:
+                break
+            live.extend(src.extract_records(b))
+            lags.append(src.lag_seconds())
+        stats = src.io_stats()
+        src.close()
+        gen_thread.join()
+        makespan = time.perf_counter() - t0
+
+        post = StreamSource(tmpdir)
+        sealed = []
+        while True:
+            r = post.next_record()
+            if r is None:
+                break
+            sealed.append(r)
+        post.close()
+
+        m = _sm.read_manifest(tmpdir)
+        def by_gen_sha(rows):
+            out, nxt = [], 0
+            for ent in m["sealed"]:
+                h = hashlib.sha256()
+                for r in rows[nxt:nxt + ent["records"]]:
+                    h.update(r)
+                out.append(h.hexdigest())
+                nxt += ent["records"]
+            return out
+
+        lags.sort()
+        p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))] if lags else 0.0
+        return {
+            "rows": len(live),
+            "bit_identical": live == sealed,
+            "per_gen_sha_identical": by_gen_sha(live) == by_gen_sha(sealed),
+            "lag_p99_seconds": round(p99, 4),
+            "lag_max_seconds": round(lags[-1], 4) if lags else 0.0,
+            "lag_bound_p99_seconds": lag_bound_p99,
+            "rotations": len(m["sealed"]) - 1,
+            "commits_seen": stats["commits_seen"],
+            "tail_wait_secs": stats["tail_wait_secs"],
+            "makespan_secs": round(makespan, 3),
+            "follow_rows_per_sec": round(len(live) / max(makespan, 1e-9), 1),
+        }
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def ensure_rec_index() -> None:
     """Index file for the bench .rec (uniform frame stride → arithmetic
     offsets; format = IndexedRecordIOWriter's ``key<TAB>offset``)."""
@@ -2883,6 +2977,17 @@ def main() -> None:
             # sockets + numpy)
             tracker_kill["failed"] = True
 
+    # streaming follow (ISSUE 19 acceptance): a paced generator vs a
+    # live tail-following trainer — staleness p99 under the pinned
+    # bound, and the live drain bit-identical (rows, order, per-
+    # generation sha) to a post-hoc read of the sealed directory
+    try:
+        stream_online = _stream_online_bench()
+    except Exception as e:
+        # pure local CPU I/O + threads: there is no legitimate
+        # capability skip, any exception is a streaming regression
+        stream_online = {"skipped": repr(e), "failed": True}
+
     # flight-recorder attribution of this very run (ISSUE 8): snapshot
     # the rings BEFORE the overhead probe (its calibration loop wraps
     # the main thread's ring), then measure the recorder's cost — the
@@ -3193,6 +3298,38 @@ def main() -> None:
                 "clean run (invariant <= 2x)"
             )
 
+    # stream_online invariant (ISSUE 19): the live follow must drain
+    # the exact sealed corpus (rows, order, per-generation hashes),
+    # keep lag_seconds p99 under the pinned bound, and see the writer
+    # actually rotate mid-follow
+    if stream_online.get("failed"):
+        failures.append(f"stream_online: {stream_online['skipped']}")
+    if "skipped" not in stream_online:
+        if not stream_online["bit_identical"]:
+            failures.append(
+                "stream_online: live tail-follow drain != post-hoc "
+                "read of the sealed corpus (bit-wise)"
+            )
+        if not stream_online["per_gen_sha_identical"]:
+            failures.append(
+                "stream_online: per-generation content hashes differ "
+                "between live follow and sealed shards"
+            )
+        if not (
+            stream_online["lag_p99_seconds"]
+            <= stream_online["lag_bound_p99_seconds"]
+        ):
+            failures.append(
+                f"stream_online: lag_seconds p99 "
+                f"{stream_online['lag_p99_seconds']}s over the "
+                f"{stream_online['lag_bound_p99_seconds']}s bound"
+            )
+        if stream_online["rotations"] < 1:
+            failures.append(
+                "stream_online: the writer never rotated mid-follow "
+                "(bench lost its dataset-switch coverage)"
+            )
+
     print(
         json.dumps(
             {
@@ -3294,6 +3431,13 @@ def main() -> None:
                 "tracker_kill_recovery": tracker_kill,
                 "tracker_recovery_makespan_ratio": tracker_kill.get(
                     "recovery_makespan_ratio"
+                ),
+                # streaming follow (ISSUE 19): paced generator vs a
+                # live tail-following reader — p99 staleness under the
+                # pinned bound, drain bit-identical to the sealed reads
+                "stream_online": stream_online,
+                "stream_lag_p99_seconds": stream_online.get(
+                    "lag_p99_seconds"
                 ),
                 **_codec_summary(),
                 # gather/legacy speedup is THE tentpole acceptance

@@ -39,6 +39,7 @@ reference implementation the native kernel's parity tests check against.
 
 from __future__ import annotations
 
+import os
 import struct
 from typing import Iterator, List, Optional, Tuple
 
@@ -111,6 +112,16 @@ def _aligned_magic_positions(payload: bytes) -> np.ndarray:
     return (np.nonzero(words == KMAGIC)[0] * 4).astype(np.int64)
 
 
+def _fsync_stream(stream) -> None:
+    """Best-effort fsync of a Stream's underlying fd: in-memory and
+    pipe-like sinks simply have no durable fd to sync."""
+    fp = getattr(stream, "_fp", stream)
+    try:
+        os.fsync(fp.fileno())
+    except (AttributeError, OSError, ValueError):
+        pass
+
+
 class RecordIOWriter:
     """Reference RecordIOWriter (recordio.h:38-115, recordio.cc:11-51).
 
@@ -127,10 +138,14 @@ class RecordIOWriter:
         codec=None,
         level: Optional[int] = None,
         block_bytes: int = DEFAULT_BLOCK_BYTES,
+        fsync: bool = False,
     ) -> None:
         self.stream = stream
         self.except_counter = 0  # number of magic collisions escaped
         self.bytes_written = 0  # framed bytes emitted through this writer
+        self.records_written = 0  # records EMITTED (durable framing), not
+        #                           records merely buffered in a pending block
+        self.fsync = fsync  # default commit() durability policy
         self.codec = (
             None if codec in (None, "", "none") else _codec.get_codec(codec)
         )
@@ -177,6 +192,7 @@ class RecordIOWriter:
             return
         self.stream.write(framed)
         self.bytes_written += len(framed)
+        self.records_written += 1
 
     def tell(self) -> int:
         check(isinstance(self.stream, SeekStream), "stream is not seekable")
@@ -193,6 +209,7 @@ class RecordIOWriter:
         base = self.bytes_written
         self.stream.write(framed)
         self.bytes_written += len(framed)
+        self.records_written += len(offsets)
         self._note_framed_records(base, offsets)
 
     def _note_framed_records(self, base: int, offsets) -> None:
@@ -253,6 +270,7 @@ class RecordIOWriter:
         self.stream.write(framed)
         self.bytes_written += len(framed)
         self.blocks_written += 1
+        self.records_written += len(self._blk_offs)
         self._note_block_records(base, self._blk_offs, self._blk_keys)
         self._blk_parts, self._blk_len = [], 0
         self._blk_offs, self._blk_keys = [], []
@@ -265,6 +283,29 @@ class RecordIOWriter:
         REQUIRED after the last record when writing with a codec."""
         self.flush_block()
         self.stream.flush()
+
+    def commit(self, fsync: Optional[bool] = None) -> Tuple[int, int]:
+        """Durable checkpoint: seal the pending block, flush data (and
+        any sidecar), optionally fsync, and return the ``(byte, record)``
+        watermark — the exact prefix a concurrent reader may consume.
+
+        Because the pending block is sealed first, the watermark always
+        lands on a frame boundary: the committed prefix decodes as whole
+        records, never a torn tail. ``fsync=None`` follows the writer's
+        constructor policy; ``True``/``False`` override per call.
+        Streams without a durable fd (pipes, memory) skip the fsync
+        silently — the flush is still the framing guarantee.
+        """
+        self.flush_block()
+        self.stream.flush()
+        do_sync = self.fsync if fsync is None else bool(fsync)
+        self._commit_sidecar(do_sync)
+        if do_sync:
+            _fsync_stream(self.stream)
+        return (self.bytes_written, self.records_written)
+
+    def _commit_sidecar(self, do_sync: bool) -> None:
+        pass  # the plain writer has no sidecar
 
     def close(self) -> None:
         """flush(); the stream itself stays caller-owned."""
@@ -297,9 +338,11 @@ class IndexedRecordIOWriter(RecordIOWriter):
         codec=None,
         level: Optional[int] = None,
         block_bytes: int = DEFAULT_BLOCK_BYTES,
+        fsync: bool = False,
     ) -> None:
         super().__init__(
-            stream, codec=codec, level=level, block_bytes=block_bytes
+            stream, codec=codec, level=level, block_bytes=block_bytes,
+            fsync=fsync,
         )
         # enforce the byte-0 contract instead of documenting it: an
         # append-positioned seekable stream would silently emit a corrupt
@@ -344,6 +387,14 @@ class IndexedRecordIOWriter(RecordIOWriter):
             lines.append(f"{kk}\t{base}:{int(o)}\n")
             self._count += 1
         self.index_stream.write("".join(lines).encode())
+
+    def _commit_sidecar(self, do_sync: bool) -> None:
+        # the sidecar commits WITH the data: a reader that trusts a
+        # committed watermark must find every committed record's index
+        # line already flushed
+        self.index_stream.flush()
+        if do_sync:
+            _fsync_stream(self.index_stream)
 
 
 class RecordIOReader:

@@ -2748,6 +2748,55 @@ def create(
         "0 <= part_index < num_parts (reference io.cc CHECK)",
     )
     spec = URISpec(uri, part_index, num_parts)
+    # streaming sugar: pointing at a stream's manifest (or &stream=1 /
+    # type='stream' on the directory) follows the LIVE stream — a
+    # tail-following StreamSource instead of a sealed-file splitter
+    # (stream/source.py, docs/streaming.md). Lazy import: stream/
+    # imports this module for the InputSplit contract.
+    from ..stream.manifest import MANIFEST_NAME as _stream_manifest_name
+
+    if (
+        type == "stream"
+        or bool(uri_int(spec.args, "stream", 0))
+        or spec.uri.rstrip("/").endswith("/" + _stream_manifest_name)
+    ):
+        from ..stream.source import StreamSource
+
+        check(
+            not spec.cache_file,
+            "a #cachefile would freeze a growing stream's first read; "
+            "streams are followed live, not cached",
+        )
+        dir_uri = spec.uri.rstrip("/")
+        if dir_uri.endswith("/" + _stream_manifest_name):
+            dir_uri = dir_uri[: -(len(_stream_manifest_name) + 1)]
+        if dynamic_shards is None:
+            dynamic_shards = bool(uri_int(spec.args, "dynamic_shards", 0))
+        check(
+            dynamic_shards or (part_index == 0 and num_parts == 1),
+            "a static stream follow drains everything (one reader); "
+            "multi-worker streaming uses &dynamic_shards=1 leased "
+            "micro-shards (docs/streaming.md)",
+        )
+        if shuffle is None:
+            shuffle = spec.args.get("shuffle", "0")
+        return StreamSource(
+            dir_uri,
+            shuffle=normalize_shuffle(shuffle),
+            seed=seed if seed else uri_int(spec.args, "seed", 0),
+            window=(
+                window
+                if window is not None
+                else uri_int(spec.args, "window", 8192, minimum=1)
+            ),
+            batch_size=(
+                batch_size
+                if batch_size is not None
+                else uri_int(spec.args, "batch_size", 256)
+            ),
+            dynamic=dynamic_shards,
+            threaded=threaded,
+        )
     # per-dataset options ride the URI (reference-style sugar); explicit
     # keyword args win when both are given:
     #   ?shuffle_parts=N&seed=S       macro-shuffle, any record type

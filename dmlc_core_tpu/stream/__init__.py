@@ -1,0 +1,36 @@
+"""Streaming ingestion: tail-follow RecordIO with watermarks & rotation.
+
+Every other source in the repo assumes SEALED files; this package makes
+growing ones a first-class scenario (ROADMAP item 4, docs/streaming.md):
+
+- ``manifest``: the single commit point between a live writer and its
+  tail-following readers — an atomically-renamed ``manifest.json``
+  naming the sealed shards, the live shard's committed (byte, record)
+  watermark, and the optional end-of-stream marker. All manifest I/O
+  and all tail-commit frame accounting live HERE (lint L020), so there
+  is exactly one implementation of "what prefix is safe to read".
+- ``writer``: ``StreamWriter`` — appends codec-block records to a live
+  ``.rec(+.idx)`` shard with periodic durable commits (flush + sidecar
+  + fsync policy), size/time rotation into a directory of shards, and
+  bounded-staleness backpressure against reader acks
+  (``DMLC_STREAM_MAX_LAG``).
+- ``source``: ``StreamSource`` — a full ``InputSplit`` (including
+  ``next_gather_batch`` onto the fused staging path) that follows the
+  manifest: windowed shuffle *within* the committed watermark, remote
+  tails via ranged reads on the retry layer, rotation as an epoch
+  boundary on the tracker's shard ledger (multi-worker streaming rides
+  leased micro-shards with exactly-once accounting), clean EOS
+  draining the final partial window.
+"""
+
+from .manifest import MANIFEST_NAME, read_manifest, write_manifest
+from .source import StreamSource
+from .writer import StreamWriter
+
+__all__ = [
+    "MANIFEST_NAME",
+    "StreamSource",
+    "StreamWriter",
+    "read_manifest",
+    "write_manifest",
+]

@@ -1,0 +1,359 @@
+"""Stream manifest: the one commit point between writer and readers.
+
+A stream is a directory of RecordIO shards plus a ``manifest.json``
+that is only ever replaced by atomic rename, never edited in place::
+
+    {
+      "version": 1,
+      "seq": 17,                      # bumped on every publish
+      "sealed": [                     # immutable, fully-committed shards
+        {"gen": 0, "data": "shard-00000.rec", "index": "shard-00000.rec.idx",
+         "bytes": 1048576, "records": 4096, "sealed_unix": ...},
+        ...
+      ],
+      "live": {                       # the growing shard (absent after EOS)
+        "gen": 2, "data": "shard-00002.rec", "index": "shard-00002.rec.idx",
+        "bytes": 524288, "records": 2048,    # committed WATERMARK
+        "committed_unix": ...
+      },
+      "eos": false,                   # true once the writer closed the stream
+      "updated_unix": ...
+    }
+
+The live shard's ``bytes``/``records`` are the durable watermark the
+writer's last ``commit()`` returned — commits seal the pending codec
+block first, so the watermark always lands on a frame boundary and the
+committed prefix decodes as whole records. Readers NEVER trust the
+on-disk file size or the ``.idx`` tail of a growing shard (both may be
+mid-write); the manifest is the only truth about what is safe to read.
+
+Lint L020 confines every manifest read/write and every tail-commit
+frame-accounting walk to THIS module: one implementation of "what
+prefix is committed", shared by the writer, the tail reader, ``tools
+info`` and the tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io import retry as _retry
+from ..io.filesystem import FileSystem
+from ..io.recordio import KMAGIC, decode_flag, decode_length
+from ..utils.logging import Error, check
+
+MANIFEST_NAME = "manifest.json"
+_ACK_PREFIX = "ack-"
+_VERSION = 1
+
+
+# -- naming -------------------------------------------------------------------
+def shard_basename(gen: int) -> str:
+    return f"shard-{gen:05d}.rec"
+
+
+def join(dir_uri: str, name: str) -> str:
+    """Protocol-preserving path join (no normalization: remote URIs
+    must keep their scheme and host untouched)."""
+    return dir_uri.rstrip("/") + "/" + name
+
+
+def manifest_uri(dir_uri: str) -> str:
+    return join(dir_uri, MANIFEST_NAME)
+
+
+# -- read/write ---------------------------------------------------------------
+def new_manifest() -> Dict:
+    return {
+        "version": _VERSION,
+        "seq": 0,
+        "sealed": [],
+        "live": None,
+        "eos": False,
+        "updated_unix": 0.0,
+    }
+
+
+def write_manifest(dir_path: str, m: Dict, fsync: bool = False) -> Dict:
+    """Publish ``m`` into ``dir_path`` (a LOCAL directory — the writer
+    side of a stream is local by design; remote readers follow via any
+    FileSystem). Bumps ``seq``, stamps ``updated_unix``, writes a temp
+    file and atomically renames it over ``manifest.json`` — a reader
+    sees either the old manifest or the new one, never a torn mix."""
+    if dir_path.startswith("file://"):
+        dir_path = dir_path[len("file://"):]
+    check(
+        "://" not in dir_path,
+        f"write_manifest needs a local directory, not {dir_path!r} "
+        "(the writer side of a stream is local; docs/streaming.md)",
+    )
+    m["seq"] = int(m.get("seq", 0)) + 1
+    m["updated_unix"] = time.time()  # noqa: L008 (manifest wall stamp, not a duration)
+    tmp = os.path.join(dir_path, f".{MANIFEST_NAME}.tmp.{os.getpid()}")
+    data = json.dumps(m, indent=1, sort_keys=True).encode()
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dir_path, MANIFEST_NAME))
+    return m
+
+
+def read_manifest(
+    dir_uri: str, policy: Optional[_retry.RetryPolicy] = None
+) -> Optional[Dict]:
+    """Load the manifest through any FileSystem backend, or None when
+    the stream directory has no manifest yet. Transient faults (remote
+    resets, an in-flight HTTP replacement) retry under ``policy``;
+    malformed JSON retries a bounded number of times too — a non-atomic
+    remote overwrite heals, persistent garbage fails loudly."""
+    uri = manifest_uri(dir_uri)
+    fs = FileSystem.get_instance(uri)
+    policy = policy or _retry.RetryPolicy()
+    garbage = 0
+    while True:
+        try:
+            if not fs.exists(uri):
+                return None
+            with fs.open(uri, "r") as s:
+                raw = s.read()
+            m = json.loads(raw.decode("utf-8"))
+            check(
+                isinstance(m, dict) and int(m.get("version", -1)) == _VERSION,
+                f"unsupported stream manifest at {uri}: "
+                f"version={m.get('version') if isinstance(m, dict) else '?'}",
+            )
+            check(
+                isinstance(m.get("sealed"), list),
+                f"malformed stream manifest at {uri}: no sealed list",
+            )
+            return m
+        except (OSError, Error) as e:
+            if isinstance(e, Error) and "manifest" in str(e):
+                raise
+            if not _retry.is_transient(e):
+                raise
+            policy.pause(e, what=f"read {uri}")
+        except ValueError as e:  # json decode: racing non-atomic publish
+            garbage += 1
+            if garbage >= 3:
+                raise Error(f"corrupt stream manifest at {uri}: {e}") from e
+            policy.pause(e, what=f"decode {uri}")
+
+
+# -- watermark queries --------------------------------------------------------
+def shard_entry(m: Dict, gen: int) -> Optional[Dict]:
+    """The manifest entry for generation ``gen`` (sealed or live), or
+    None when that generation does not exist (yet)."""
+    sealed = m["sealed"]
+    if gen < len(sealed):
+        return sealed[gen]
+    live = m.get("live")
+    if live is not None and int(live["gen"]) == gen:
+        return live
+    return None
+
+
+def is_sealed(m: Dict, gen: int) -> bool:
+    return gen < len(m["sealed"])
+
+
+def total_committed(m: Dict) -> Tuple[int, int]:
+    """Cumulative committed (bytes, records) across the whole stream."""
+    b = sum(int(e["bytes"]) for e in m["sealed"])
+    r = sum(int(e["records"]) for e in m["sealed"])
+    live = m.get("live")
+    if live is not None:
+        b += int(live["bytes"])
+        r += int(live["records"])
+    return b, r
+
+
+# -- reader acks (bounded staleness) ------------------------------------------
+def write_ack(dir_path: str, reader_id: str, records: int) -> None:
+    """Publish a reader's consumed-record count (atomic rename, same
+    contract as the manifest). Local directories only — acks gate the
+    WRITER, which is local by design."""
+    if dir_path.startswith("file://"):
+        dir_path = dir_path[len("file://"):]
+    if "://" in dir_path:
+        return  # remote follower: no ack channel, lag is surfaced loudly
+    name = f"{_ACK_PREFIX}{reader_id}.json"
+    tmp = os.path.join(dir_path, f".{name}.tmp.{os.getpid()}")
+    payload = {
+        "records": int(records),
+        "updated_unix": time.time(),  # noqa: L008 (ack wall stamp, not a duration)
+    }
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, os.path.join(dir_path, name))
+
+
+def read_acks(dir_path: str) -> Dict[str, Dict]:
+    """reader_id -> {records, updated_unix} for every published ack."""
+    if dir_path.startswith("file://"):
+        dir_path = dir_path[len("file://"):]
+    out: Dict[str, Dict] = {}
+    if "://" in dir_path or not os.path.isdir(dir_path):
+        return out
+    for name in os.listdir(dir_path):
+        if not (name.startswith(_ACK_PREFIX) and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(dir_path, name), encoding="utf-8") as f:
+                out[name[len(_ACK_PREFIX):-5]] = json.load(f)
+        except (OSError, ValueError):
+            continue  # torn/garbage ack: skip, next publish heals it
+    return out
+
+
+# -- tail-commit frame accounting ---------------------------------------------
+def whole_record_prefix(buf) -> int:
+    """Largest prefix of a RAW extent (compressed blocks still framed)
+    that ends on a complete top-level record/blob boundary — where an
+    extent capped mid-frame must be cut so ``decode_chunk`` and
+    ``walk_frames`` only ever see whole frames. The buffer must begin
+    on a frame head (extents start at the previous cut, which did)."""
+    view = memoryview(buf)
+    n = len(view)
+    pos = 0
+    committed = 0
+    while pos + 8 <= n:
+        magic, lrec = struct.unpack_from("<II", view, pos)
+        check(magic == KMAGIC, f"stream extent: bad magic at byte {pos}")
+        cflag = decode_flag(lrec)
+        end = pos + 8 + ((decode_length(lrec) + 3) & ~3)
+        if end > n:
+            break
+        if (cflag & 3) in (0, 3):
+            committed = end
+        pos = end
+    return committed
+
+
+def walk_frames(buf) -> Tuple[np.ndarray, np.ndarray]:
+    """(starts, sizes) int64 arrays of whole FRAMED records in a v1
+    buffer that begins on a frame head and contains only whole frames
+    (a committed extent, post block-decode). Multipart chains collapse
+    into one span; a malformed header is a checked error — committed
+    bytes are whole frames by the manifest contract."""
+    view = memoryview(buf)
+    n = len(view)
+    starts: List[int] = []
+    sizes: List[int] = []
+    pos = 0
+    open_start = -1  # start of an in-flight multipart chain
+    while pos < n:
+        check(pos + 8 <= n, "stream extent: truncated frame header")
+        magic, lrec = struct.unpack_from("<II", view, pos)
+        check(magic == KMAGIC, f"stream extent: bad magic at byte {pos}")
+        cflag = decode_flag(lrec)
+        check(
+            cflag < 4,
+            f"stream extent: compressed frame (cflag {cflag}) survived "
+            "decode — decode_chunk the extent first",
+        )
+        end = pos + 8 + ((decode_length(lrec) + 3) & ~3)
+        check(end <= n, "stream extent: frame overruns committed bytes")
+        part = cflag & 3
+        if part in (0, 1):
+            check(open_start < 0, "stream extent: nested record head")
+            open_start = pos
+        else:
+            check(open_start >= 0, "stream extent: continuation without head")
+        if part in (0, 3):
+            starts.append(open_start)
+            sizes.append(end - open_start)
+            open_start = -1
+        pos = end
+    check(open_start < 0, "stream extent: unterminated multipart record")
+    return (
+        np.asarray(starts, dtype=np.int64),
+        np.asarray(sizes, dtype=np.int64),
+    )
+
+
+def frame_payload(frame) -> Optional[memoryview]:
+    """Payload view of a single FRAMED record if it is one complete
+    (non-multipart) frame, else ``None`` — the caller falls back to a
+    chunk reader for multipart chains. A bad head is a checked error:
+    window slices come from ``walk_frames`` starts/sizes."""
+    magic, lrec = struct.unpack_from("<II", frame, 0)
+    check(magic == KMAGIC, "stream window: bad frame head")
+    if decode_flag(lrec) != 0:
+        return None
+    return memoryview(frame)[8 : 8 + decode_length(lrec)]
+
+
+def count_records(chunk) -> int:
+    """Record count of a framed chunk (lag accounting for the
+    chunk-shaped API): one lenient pass over the frame heads —
+    compressed blocks count as one, foreign bytes end the walk."""
+    view = memoryview(chunk)
+    n = len(view)
+    pos = 0
+    count = 0
+    while pos + 8 <= n:
+        magic, lrec = struct.unpack_from("<II", view, pos)
+        if magic != KMAGIC:
+            break
+        if (decode_flag(lrec) & 3) in (0, 3):
+            count += 1
+        pos += 8 + ((decode_length(lrec) + 3) & ~3)
+    return count
+
+
+def scan_committed_prefix(uri: str, size: Optional[int] = None) -> Dict:
+    """Walk a (possibly still growing) shard from byte 0 and report the
+    largest whole-frame prefix: ``{"committed_bytes", "tail_bytes",
+    "frames", "blocks", "records"}``. Bytes past the last whole frame
+    are the writer's in-flight tail — UNCOMMITTED, not corruption.
+    ``records`` counts v1 records only; compressed blocks count under
+    ``blocks`` (their records need a decode to enumerate)."""
+    fs = FileSystem.get_instance(uri)
+    if size is None:
+        size = fs.get_path_info(uri).size
+    frames = blocks = records = 0
+    committed = 0
+    open_chain = False
+    with fs.open(uri, "r") as s:
+        pos = 0
+        while pos + 8 <= size:
+            s.seek(pos)
+            head = s.read(8)
+            if len(head) < 8:
+                break
+            magic, lrec = struct.unpack("<II", head)
+            if magic != KMAGIC:
+                break  # torn/foreign bytes: everything from here is tail
+            cflag = decode_flag(lrec)
+            end = pos + 8 + ((decode_length(lrec) + 3) & ~3)
+            if end > size:
+                break  # frame extends past EOF: in-flight write
+            frames += 1
+            part = cflag & 3
+            if part in (0, 1):
+                open_chain = True
+            if part in (0, 3):
+                open_chain = False
+                if cflag & 4:
+                    blocks += 1
+                else:
+                    records += 1
+                committed = end  # only whole RECORDS commit, not parts
+            pos = end
+    return {
+        "committed_bytes": committed,
+        "tail_bytes": int(size) - committed,
+        "frames": frames,
+        "blocks": blocks,
+        "records": records,
+        "open_chain": open_chain,
+    }

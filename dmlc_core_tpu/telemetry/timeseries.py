@@ -373,6 +373,15 @@ def _hist_delta(
         return None
 
 
+def _gauge_last(gauges: Dict[str, Any], name: str):
+    """Windowed-last of a gauge family; tolerates both the windowed
+    ``{last,min,max}`` shape and a bare snapshot scalar."""
+    g = gauges.get(name)
+    if isinstance(g, dict):
+        return g.get("last")
+    return g
+
+
 def _rate(counters: Dict[str, Any], name: str) -> float:
     """Summed per-sec rate of every series in a counter family."""
     total = 0.0
@@ -442,6 +451,16 @@ def _derive(
     qd = gauges.get("tracker.shards.queue_depth")
     if qd is not None:
         out["shard_queue_depth"] = qd
+    # streaming follow: how stale is this rank's tail reader? (reader-
+    # side gauges, stream/source.py; the writer publishes the same
+    # watermark/lag family from its vantage)
+    lag_r = _gauge_last(gauges, "stream.lag_records")
+    lag_s = _gauge_last(gauges, "stream.lag_seconds")
+    wm = _gauge_last(gauges, "stream.watermark_records")
+    if wm is not None or lag_r is not None:
+        out["stream_watermark_records"] = wm or 0.0
+        out["stream_lag_records"] = lag_r or 0.0
+        out["stream_lag_seconds"] = round(lag_s or 0.0, 3)
     return out
 
 
@@ -515,6 +534,15 @@ def merge_windows(views: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
             )
         if "shard_queue_depth" in d:
             derived["shard_queue_depth"] = d["shard_queue_depth"]
+        # cluster staleness is the SLOWEST follower's, not an average —
+        # a lagging rank is exactly what the lag column must surface
+        for k in (
+            "stream_lag_seconds",
+            "stream_lag_records",
+            "stream_watermark_records",
+        ):
+            if k in d:
+                derived[k] = max(derived.get(k, 0.0), d[k])
     derived["stall_fraction"] = {
         k: round(sum(v) / len(v), 4) for k, v in sorted(stall.items())
     }

@@ -843,6 +843,10 @@ _WAIT_STAGES = frozenset(
                               # daemon's answer: a cold cache, an
                               # overloaded tier, or network latency
                               # (io/lookup.py LookupClient)
+        "stream_tail_wait",   # tail-following reader caught up to the
+                              # writer's committed watermark: parked on
+                              # the next commit/rotation/EOS
+                              # (stream/source.py, docs/streaming.md)
         "slot_wait",
     }
 )

@@ -304,12 +304,27 @@ def _cmd_info(args) -> int:
     report = build_info()
     if getattr(args, "uri", None):
         from ..io.lookup import RecordLookup
+        from ..utils.logging import Error as _Err
 
-        handle = RecordLookup(args.uri, args.index or None)
+        handle = None
         try:
+            handle = RecordLookup(args.uri, args.index or None)
             report["shard"] = handle.describe()
+        except (_Err, OSError, ValueError):
+            # a GROWING shard (stream/writer.py live generation): the
+            # sidecar tail or final block may be mid-write — walk the
+            # whole-frame prefix instead and report the in-flight tail
+            # as uncommitted, not as corruption
+            from ..stream import manifest as _stream_manifest
+
+            scan = _stream_manifest.scan_committed_prefix(args.uri)
+            scan["status"] = (
+                f"growing (tail_bytes={scan['tail_bytes']} uncommitted)"
+            )
+            report["shard"] = scan
         finally:
-            handle.close()
+            if handle is not None:
+                handle.close()
     print(json.dumps(report, indent=2))
     return 0
 
@@ -749,6 +764,9 @@ def _top_model(report: dict, window: float) -> dict:
                     "dsserve_wire_ratio",
                     "dsserve_shm_frac",
                     "shard_queue_depth",
+                    "stream_lag_seconds",
+                    "stream_lag_records",
+                    "stream_watermark_records",
                 )
                 if k in d
             },
@@ -819,6 +837,12 @@ def _render_top(model: dict, endpoint: str) -> str:
         if "dsserve_shm_frac" in cd:
             dss += f" shm {cd['dsserve_shm_frac'] * 100:.0f}%"
         summary.append(dss)
+    if "stream_lag_seconds" in cd:
+        # slowest follower across the fleet (merge_windows takes max)
+        summary.append(
+            f"stream lag {cd['stream_lag_seconds']:.2f}s"
+            f"/{cd.get('stream_lag_records', 0):g} recs"
+        )
     lines.append("  ".join(summary))
     asc = model.get("autoscale")
     if asc:
@@ -842,7 +866,14 @@ def _render_top(model: dict, endpoint: str) -> str:
             parts.append(f"flaps {asc['direction_changes']}")
         lines.append("  ".join(parts))
     lines.append("")
-    lines.append(f"{'rank':>8}  {'rows/s':>10}  stall by stage")
+    # the lag column only appears on streaming jobs — a sealed-corpus
+    # top keeps its exact layout
+    has_lag = any(
+        "stream_lag_seconds" in r
+        for r in (model.get("ranks") or {}).values()
+    )
+    lag_head = f"{'lag':>8}  " if has_lag else ""
+    lines.append(f"{'rank':>8}  {'rows/s':>10}  {lag_head}stall by stage")
     for rank, r in (model.get("ranks") or {}).items():
         stalls = sorted(
             (r.get("stall_fraction") or {}).items(),
@@ -862,9 +893,15 @@ def _render_top(model: dict, endpoint: str) -> str:
             extras.append(f"shm {r['dsserve_shm_frac'] * 100:.0f}%")
         if extras:
             stall_txt = "  ".join(filter(None, [stall_txt, *extras]))
+        lag_txt = ""
+        if has_lag:
+            if "stream_lag_seconds" in r:
+                lag_txt = f"{r['stream_lag_seconds']:.2f}s".rjust(8) + "  "
+            else:
+                lag_txt = f"{'-':>8}  "
         lines.append(
             f"{rank:>8}  {_fmt_rate(r.get('rows_per_sec', 0.0)):>10}  "
-            f"{stall_txt}"
+            f"{lag_txt}{stall_txt}"
         )
     return "\n".join(lines)
 

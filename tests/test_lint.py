@@ -773,3 +773,79 @@ def test_journal_crc_framing_gate_matches_repo_state():
             continue
         findings += [x for x in lint.lint_file(f) if x[2] == "L018"]
     assert findings == []
+
+
+def test_stream_manifest_literal_flagged_library_wide(tmp_path):
+    """L020: the "manifest.json" filename is spelled once — a literal
+    anywhere else in the library (plain or f-string) hand-rolls the
+    commit-point path."""
+    assert [c for c, _ in _lib_findings(
+        "p = dir_uri + '/manifest.json'\n", tmp_path)] == ["L020"]
+    assert [c for c, _ in _lib_findings(
+        "p = f'{d}/manifest.json'\n", tmp_path)] == ["L020"]
+    assert [c for c, _ in _lib_findings(
+        "import os\np = os.path.join(d, 'manifest.json')\n", tmp_path)
+    ] == ["L020"]
+    # the sanctioned alias — the imported constant — never flags
+    assert [c for c, _ in _lib_findings(
+        "from ..stream.manifest import MANIFEST_NAME\n"
+        "p = d + '/' + MANIFEST_NAME\n", tmp_path) if c == "L020"] == []
+    # per-line opt-out works like every other rule
+    assert [c for c, _ in _lib_findings(
+        "p = d + '/manifest.json'  # noqa: L020 (fixture)\n", tmp_path)
+            if c == "L020"] == []
+
+
+def test_stream_tail_frame_walk_flagged(tmp_path):
+    """L020: decode_length-driven frame walks (where the committed
+    prefix ends) are manifest.py's business — the import flags, and a
+    call through a module alias flags the call site too."""
+    assert [c for c, _ in _lib_findings(
+        "from ..io.recordio import decode_length\n", tmp_path)
+            if c == "L020"] == ["L020"]
+    # aliasing the name doesn't dodge the rule; the call flags as well
+    assert [c for c, _ in _lib_findings(
+        "from dmlc_core_tpu.io.recordio import decode_length as dl\n"
+        "n = dl(lrec)\n", tmp_path) if c == "L020"] == ["L020", "L020"]
+    assert [c for c, _ in _lib_findings(
+        "from ..io import recordio as rio\n"
+        "n = rio.decode_length(lrec)\n", tmp_path) if c == "L020"
+    ] == ["L020"]
+    # the FLAG sniff (staging/fused.py's compression probe) is fine —
+    # it never advances a walk, so it can't disagree about the tail
+    assert [c for c, _ in _lib_findings(
+        "from ..io.recordio import KMAGIC, decode_flag\n"
+        "ok = decode_flag(lrec) & 4\n", tmp_path) if c == "L020"] == []
+
+
+def test_stream_manifest_quiet_in_owner_and_outside_scope(tmp_path):
+    # stream/manifest.py owns the filename AND the walks — both are
+    # allowed there
+    d = tmp_path / "dmlc_core_tpu" / "stream"
+    d.mkdir(parents=True)
+    f = d / "manifest.py"
+    f.write_text(
+        "from ..io.recordio import KMAGIC, decode_flag, decode_length\n"
+        "MANIFEST_NAME = 'manifest.json'\n"
+        "ok = magic == KMAGIC and decode_flag(lrec) < 4\n"
+        "n = decode_length(lrec)\n")
+    assert [c for (_, _, c, _) in lint.lint_file(f)] == []
+    # docstrings that MENTION the filename are prose, not a spelling
+    assert [c for c, _ in _lib_findings(
+        '"""Reads the manifest.json commit point."""\n'
+        "def f():\n"
+        "    '''follows manifest.json'''\n", tmp_path) if c == "L020"] == []
+    # outside dmlc_core_tpu/ (tests, tools) the rule does not apply
+    assert codes("p = d + '/manifest.json'\n", tmp_path) == []
+
+
+def test_stream_manifest_gate_matches_repo_state():
+    """The real tree passes L020 (the filename and the tail-frame
+    walks live only in stream/manifest.py)."""
+    repo = lint.REPO
+    findings = []
+    for f in sorted((repo / "dmlc_core_tpu").rglob("*.py")):
+        if "__pycache__" in f.parts:
+            continue
+        findings += [x for x in lint.lint_file(f) if x[2] == "L020"]
+    assert findings == []

@@ -1,0 +1,576 @@
+"""Streaming ingestion (dmlc_core_tpu/stream/, docs/streaming.md):
+tail-follow RecordIO sources over a manifest-committed shard directory.
+
+Covers the durable-commit contract on the RecordIO writers (flush never
+exposes a partial block), manifest atomicity, writer rotation + EOS,
+live-follow vs post-hoc order equivalence (sequential AND windowed
+shuffle, including a reader parked mid-window across a rotation), the
+chaos fault:// variant, bounded staleness backpressure, `tools info` on
+a growing shard, the stream.* telemetry derivations, and THE 2-worker
+``dmlc-submit`` drill with the writer rotating mid-job.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import zlib  # noqa: L009 (crc32 as an order-free fold, not compression)
+
+import pytest
+
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.io.recordio import IndexedRecordIOWriter, RecordIOWriter
+from dmlc_core_tpu.io.stream import FileStream
+from dmlc_core_tpu.stream import StreamSource, StreamWriter
+from dmlc_core_tpu.stream import manifest as sm
+from dmlc_core_tpu.utils.logging import Error
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _payload(i: int) -> bytes:
+    # variable sizes so codec blocks and windows cut at odd offsets
+    return (b"rec-%08d|" % i) * (1 + i % 4)
+
+
+def _drain(src) -> list:
+    out = []
+    while True:
+        r = src.next_record()
+        if r is None:
+            return out
+        out.append(r)
+
+
+def _posthoc(d: str, **kw) -> list:
+    src = StreamSource(d, **kw)
+    try:
+        return _drain(src)
+    finally:
+        src.close()
+
+
+# -- satellite: the durable-commit contract on the RecordIO writers -----------
+
+
+def test_commit_returns_frame_aligned_watermark(tmp_path):
+    p = str(tmp_path / "w.rec")
+    with FileStream(p, "w") as f:
+        w = RecordIOWriter(f, codec="zlib", block_bytes=1 << 20)
+        for i in range(10):
+            w.write_record(_payload(i))
+        b, r = w.commit()
+    assert r == 10 and b == os.path.getsize(p)
+    scan = sm.scan_committed_prefix(p)
+    assert scan["committed_bytes"] == b and scan["tail_bytes"] == 0
+    # the committed prefix decodes as exactly the appended records
+    sp = io_split.create(p, 0, 1, type="recordio", shuffle=None)
+    got = _drain(sp)
+    sp.close()
+    assert got == [_payload(i) for i in range(10)]
+
+
+def test_flush_never_exposes_partial_block(tmp_path):
+    """THE regression: a raw stream flush() mid-codec-block must leave
+    only whole frames on disk — the pending block stays in the writer's
+    buffer until commit() seals it, so a tail reader can never decode a
+    torn block."""
+    p = str(tmp_path / "partial.rec")
+    f = FileStream(p, "w")
+    w = RecordIOWriter(f, codec="zlib", block_bytes=256)
+    for i in range(40):  # several sealed blocks + a pending partial one
+        w.write_record(_payload(i))
+    f.flush()  # what a crashy writer's OS buffers would do
+    scan = sm.scan_committed_prefix(p)
+    assert scan["tail_bytes"] == 0, "flush exposed a torn frame"
+    assert scan["committed_bytes"] == os.path.getsize(p)
+    b, r = w.commit()
+    f.close()
+    assert r == 40
+    scan = sm.scan_committed_prefix(p)
+    assert scan["committed_bytes"] == b == os.path.getsize(p)
+    assert scan["tail_bytes"] == 0
+
+
+def test_indexed_writer_commit_and_fsync_knob(tmp_path):
+    p = str(tmp_path / "idx.rec")
+    ip = p + ".idx"
+    with FileStream(p, "w") as f, FileStream(ip, "w") as fi:
+        w = IndexedRecordIOWriter(f, fi, codec="zlib", block_bytes=512,
+                                  fsync=True)
+        for i in range(30):
+            w.write_record(_payload(i))
+        b1, r1 = w.commit()  # fsync=None -> constructor knob (True)
+        for i in range(30, 50):
+            w.write_record(_payload(i))
+        b2, r2 = w.commit(fsync=False)
+    assert (r1, r2) == (30, 50) and b2 > b1
+    # the sidecar was flushed at commit: both files are durable + whole
+    assert os.path.getsize(ip) > 0
+    assert sm.scan_committed_prefix(p)["tail_bytes"] == 0
+
+
+# -- the manifest commit point ------------------------------------------------
+
+
+def test_manifest_roundtrip_seq_and_missing(tmp_path):
+    d = str(tmp_path)
+    assert sm.read_manifest(d) is None
+    m = sm.new_manifest()
+    m["live"] = {"gen": 0, "data": "shard-00000.rec",
+                 "index": "shard-00000.rec.idx", "bytes": 0, "records": 0,
+                 "committed_unix": 0.0}
+    sm.write_manifest(d, m)
+    sm.write_manifest(d, m)
+    got = sm.read_manifest(d)
+    assert got["seq"] == 2 and got["live"]["gen"] == 0
+    assert got["sealed"] == [] and got["eos"] is False
+    # no torn temp files left behind by the atomic publish
+    assert [n for n in os.listdir(d) if n.endswith(".tmp")] == []
+
+
+def test_manifest_garbage_fails_loudly(tmp_path, monkeypatch):
+    monkeypatch.setenv("DMLC_RETRY_BASE_MS", "1")
+    (tmp_path / sm.MANIFEST_NAME).write_text("{not json")
+    with pytest.raises(Error, match="corrupt stream manifest"):
+        sm.read_manifest(str(tmp_path))
+
+
+# -- writer lifecycle: rotation, EOS, sealed shards ---------------------------
+
+
+def test_writer_rotates_and_seals_readable_shards(tmp_path):
+    d = str(tmp_path)
+    with StreamWriter(d, codec="zlib", block_bytes=512, rotate_bytes=2048,
+                      commit_records=25) as w:
+        for i in range(300):
+            w.append(_payload(i))
+    m = sm.read_manifest(d)
+    assert m["eos"] is True and m["live"] is None
+    assert len(m["sealed"]) >= 2, "rotate_bytes=2048 never rotated"
+    assert sum(e["records"] for e in m["sealed"]) == 300
+    nxt = 0
+    for ent in m["sealed"]:
+        shard = os.path.join(d, ent["data"])
+        scan = sm.scan_committed_prefix(shard)
+        assert scan["tail_bytes"] == 0
+        assert scan["committed_bytes"] == ent["bytes"] == os.path.getsize(
+            shard
+        )
+        # each sealed shard reads as a plain indexed recordio dataset
+        sp = io_split.create(shard, 0, 1, type="recordio",
+                             index_uri=os.path.join(d, ent["index"]))
+        got = _drain(sp)
+        sp.close()
+        assert got == [_payload(i) for i in range(nxt, nxt + ent["records"])]
+        nxt += ent["records"]
+    assert nxt == 300
+
+
+def test_writer_empty_rotation_and_empty_close(tmp_path):
+    d = str(tmp_path)
+    w = StreamWriter(d, codec=None)
+    w.rotate()  # nothing appended: must not seal an empty generation
+    w.close(eos=True)
+    m = sm.read_manifest(d)
+    assert m["sealed"] == [] and m["live"] is None and m["eos"] is True
+    # the empty live shard's files were dropped, not sealed
+    assert [n for n in os.listdir(d) if n.endswith(".rec")] == []
+
+
+# -- live follow == post-hoc read ---------------------------------------------
+
+
+def test_live_follow_sequential_matches_posthoc(tmp_path):
+    d = str(tmp_path)
+    expect = [_payload(i) for i in range(400)]
+
+    def produce():
+        with StreamWriter(d, codec="zlib", block_bytes=512,
+                          rotate_bytes=4096, commit_records=20) as w:
+            for i, rec in enumerate(expect):
+                w.append(rec)
+                if i % 50 == 49:
+                    time.sleep(0.01)  # let the follower catch the tail
+
+    t = threading.Thread(target=produce)
+    t.start()
+    src = StreamSource(d, poll_secs=0.005, max_idle_secs=30.0)
+    live = _drain(src)
+    stats = src.io_stats()
+    src.close()
+    t.join()
+    assert live == expect
+    assert _posthoc(d) == expect
+    assert stats["commits_seen"] >= 2 and stats["rotations_seen"] >= 1
+
+
+def test_live_follow_shuffled_rotation_race_matches_posthoc(tmp_path):
+    """The rotation-race acceptance: a reader parked MID-WINDOW when
+    the writer seals the live shard must flush the partial window at
+    the boundary and produce exactly the order a post-hoc read of the
+    sealed directory produces (same seed -> same window permutations)."""
+    d = str(tmp_path)
+    kw = dict(shuffle="window", seed=11, window=64)
+    w = StreamWriter(d, codec="zlib", block_bytes=512,
+                     rotate_bytes=1 << 30, commit_records=0)
+    src = StreamSource(d, poll_secs=0.005, max_idle_secs=30.0, **kw)
+    for i in range(100):
+        w.append(_payload(i))
+    w.commit()
+    # one full window is ready; the 36 leftovers are pending mid-window
+    live = [src.next_record() for _ in range(64)]
+    w.rotate()  # seal gen 0 under the reader's feet
+    for i in range(100, 150):
+        w.append(_payload(i))
+    w.close(eos=True)
+    live += _drain(src)
+    src.close()
+    assert sorted(live) == sorted(_payload(i) for i in range(150))
+    assert live == _posthoc(d, **kw)
+    # per-shard order is bit-identical: shard boundaries partition the
+    # sequence at the sealed record counts
+    m = sm.read_manifest(d)
+    assert [e["records"] for e in m["sealed"]] == [100, 50]
+    assert sorted(live[:100]) == sorted(_payload(i) for i in range(100))
+
+
+def test_live_follow_chaos_faults_heal(tmp_path):
+    """The fault:// variant: transient open errors + mid-read resets on
+    BOTH the manifest and the shard tails heal through the retry layer
+    (retries > 0) without reordering or dropping a record."""
+    from dmlc_core_tpu.io import retry
+
+    d = str(tmp_path)
+    expect = [_payload(i) for i in range(200)]
+    with StreamWriter(d, codec="zlib", block_bytes=512, rotate_bytes=4096,
+                      commit_records=40) as w:
+        for rec in expect:
+            w.append(rec)
+    before = retry.stats()
+    got = _posthoc(f"fault://errors=2,resets=1,seed=7{d}", poll_secs=0.005,
+                   max_idle_secs=30.0)
+    delta = retry.stats_delta(before)
+    assert got == expect
+    assert delta["retries"] > 0, "the chaos run never exercised a retry"
+
+
+# -- bounded staleness (DMLC_STREAM_MAX_LAG) ----------------------------------
+
+
+def test_writer_blocks_on_reader_lag_then_resumes(tmp_path):
+    d = str(tmp_path)
+    w = StreamWriter(d, codec=None, commit_records=10, max_lag=30,
+                     lag_policy="block", lag_poll_secs=0.005)
+    src = StreamSource(d, poll_secs=0.005, ack_id="r0", max_idle_secs=30.0)
+    done = threading.Event()
+
+    def produce():
+        for i in range(120):
+            w.append(_payload(i))
+        done.set()
+
+    # an ack at 0 records makes the writer's lag observable immediately
+    sm.write_ack(d, "r0", 0)
+    t = threading.Thread(target=produce)
+    t.start()
+    time.sleep(0.3)
+    assert not done.is_set(), "writer never blocked at max_lag=30"
+    assert w.backpressure_waits >= 1
+    assert w.records_appended < 120
+    got = []
+    while len(got) < 120:  # drain; acks ride _account and release the writer
+        r = src.next_record()
+        assert r is not None
+        got.append(r)
+    t.join(timeout=30)
+    assert done.is_set()
+    w.close(eos=True)
+    src.close()
+    assert got == [_payload(i) for i in range(120)]
+    assert w.stats()["backpressure_secs"] > 0
+
+
+def test_writer_lag_policy_warn_never_blocks(tmp_path):
+    d = str(tmp_path)
+    sm.write_ack(d, "r0", 0)
+    with StreamWriter(d, codec=None, max_lag=5, lag_policy="warn") as w:
+        for i in range(50):
+            w.append(_payload(i))
+        assert w.backpressure_waits == 0
+
+
+# -- tools info on a growing shard --------------------------------------------
+
+
+def test_tools_info_growing_shard_reports_uncommitted_tail(tmp_path, capsys):
+    from dmlc_core_tpu.tools import main as tools_main
+
+    d = str(tmp_path)
+    w = StreamWriter(d, codec="zlib", block_bytes=512, commit_records=0)
+    for i in range(60):
+        w.append(_payload(i))
+    w.commit()
+    live = sm.read_manifest(d)["live"]
+    shard = os.path.join(d, live["data"])
+    # a mid-write data tail: half a frame header past the watermark
+    with open(shard, "ab") as f:
+        f.write(b"\x0a\x23\xd7\xce\x40")
+    assert tools_main(["info", shard]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["shard"]["status"] == "growing (tail_bytes=5 uncommitted)"
+    assert report["shard"]["committed_bytes"] == live["bytes"]
+    assert report["shard"]["blocks"] > 0
+    w.close(eos=False)
+
+
+# -- telemetry: derive, merge, and the top lag column -------------------------
+
+
+def test_timeseries_derives_stream_lag(tmp_path):
+    from dmlc_core_tpu.telemetry import timeseries as ts
+
+    def sample(t, seq, lag_r, lag_s, wm):
+        return {"t": t, "seq": seq, "counters": {}, "histograms": {},
+                "gauges": {"stream.lag_records": lag_r,
+                           "stream.lag_seconds": lag_s,
+                           "stream.watermark_records": wm}}
+
+    win = ts.windowed([sample(100.0, 1, 40.0, 0.5, 200.0),
+                       sample(110.0, 2, 10.0, 1.25, 400.0)], 60.0)
+    assert win["derived"]["stream_lag_records"] == 10.0
+    assert win["derived"]["stream_lag_seconds"] == 1.25
+    assert win["derived"]["stream_watermark_records"] == 400.0
+    # cluster staleness is the SLOWEST follower's, never an average
+    views = {
+        str(i): {"samples": 2, "counters": {}, "gauges": {},
+                 "derived": {"rows_per_sec": 1.0, "stream_lag_seconds": s,
+                             "stream_lag_records": r,
+                             "stream_watermark_records": 400.0}}
+        for i, (s, r) in enumerate(((0.2, 5.0), (3.5, 90.0)))
+    }
+    merged = ts.merge_windows(views)
+    assert merged["derived"]["stream_lag_seconds"] == 3.5
+    assert merged["derived"]["stream_lag_records"] == 90.0
+
+
+def test_top_model_and_render_show_lag_column():
+    from dmlc_core_tpu.tools import _render_top, _top_model
+
+    def rank(lag_s, lag_r):
+        return {"samples": 3, "counters": {}, "gauges": {},
+                "derived": {"rows_per_sec": 10.0, "stall_fraction": {},
+                            "stream_lag_seconds": lag_s,
+                            "stream_lag_records": lag_r,
+                            "stream_watermark_records": 500.0}}
+
+    report = {
+        "windowed": {
+            "per_rank": {"0": rank(0.25, 3.0), "1": rank(2.5, 80.0)},
+            "cluster": {"n_ranks": 2,
+                        "derived": {"rows_per_sec": 20.0,
+                                    "stall_fraction": {},
+                                    "stream_lag_seconds": 2.5,
+                                    "stream_lag_records": 80.0}},
+        }
+    }
+    model = _top_model(report, 30.0)
+    assert model["ranks"]["1"]["stream_lag_seconds"] == 2.5
+    txt = _render_top(model, "127.0.0.1:9999")
+    assert "lag" in txt, txt
+    assert "0.25s" in txt and "2.50s" in txt
+    assert "stream lag 2.50s/80 recs" in txt
+    # a sealed-corpus job (no stream keys) renders without the column
+    for r in report["windowed"]["per_rank"].values():
+        for k in list(r["derived"]):
+            if k.startswith("stream_"):
+                del r["derived"][k]
+    report["windowed"]["cluster"]["derived"] = {
+        "rows_per_sec": 20.0, "stall_fraction": {}}
+    plain = _render_top(_top_model(report, 30.0), "127.0.0.1:9999")
+    assert "lag" not in plain
+
+
+def test_stream_tail_wait_is_a_stall_stage():
+    from dmlc_core_tpu.telemetry.tracing import _WAIT_STAGES
+
+    assert "stream_tail_wait" in _WAIT_STAGES
+
+
+# -- the fused staging-path gather contract -----------------------------------
+
+
+def test_create_routes_manifest_uri_and_gathers(tmp_path):
+    d = str(tmp_path)
+    with StreamWriter(d, codec="zlib", block_bytes=512, rotate_bytes=4096,
+                      commit_records=50) as w:
+        for i in range(300):
+            w.append(_payload(i))
+    sp = io_split.create(d + "/manifest.json?shuffle=window&window=32&seed=3",
+                         0, 1)
+    assert isinstance(sp, StreamSource) and sp.supports_gather()
+    seen = []
+    while True:
+        g = sp.next_gather_batch(48)
+        if g is None:
+            break
+        buf, starts, sizes = g
+        assert len(starts) == len(sizes) and len(starts) <= 48
+        for s, z in zip(starts.tolist(), sizes.tolist()):
+            for rec in sp.extract_records(bytes(buf[s:s + z])):
+                seen.append(rec)
+    sp.close()
+    assert sorted(seen) == sorted(_payload(i) for i in range(300))
+    # dataset-level equivalence with the record-shaped drain
+    assert seen == _posthoc(d, shuffle="window", seed=3, window=32)
+
+
+def test_stream_source_is_single_reader_unless_dynamic(tmp_path):
+    d = str(tmp_path)
+    with StreamWriter(d, codec=None) as w:
+        w.append(b"x")
+    with pytest.raises(Error, match="dynamic_shards"):
+        io_split.create(d + "/manifest.json", 1, 2)
+    with pytest.raises(Error, match="cachefile"):
+        io_split.create(d + "/manifest.json#cache.rec", 0, 1)
+
+
+# -- THE dmlc-submit acceptance: writer rotating mid-job ----------------------
+
+_STREAM_WORKER = """
+import json, os, sys, time, zlib
+sys.path.insert(0, {repo!r})
+from dmlc_core_tpu.io import split as io_split
+from dmlc_core_tpu.tracker.client import RabitWorker
+w = RabitWorker()
+rank = w.start()
+sp = io_split.create(
+    {d!r} + "/manifest.json?dynamic_shards=1&shuffle=window"
+           + "&window=64&seed=9",
+    threaded=False)
+events = []
+sp.on_shard_done = lambda gen, shard, status: events.append(
+    [gen, shard, status])
+by_gen = {{}}
+theta = 0
+rows = 0
+while True:
+    rec = sp.next_record()
+    if rec is None:
+        break
+    by_gen.setdefault(str(sp.generation), []).append(zlib.crc32(rec))
+    theta += zlib.crc32(rec)  # order-independent integer "gradient"
+    rows += 1
+    time.sleep(0.002)  # pace the drain across a few sample intervals
+sp.close()
+with open(os.path.join({out!r}, "worker-%d.json" % rank), "w") as f:
+    json.dump({{"rank": rank, "rows": rows, "theta": theta,
+               "by_gen": by_gen, "events": events}}, f)
+w.heartbeat()  # ships the ring's samples (stream.* gauges included)
+w.shutdown()
+"""
+
+N_DRILL = 600
+
+
+def test_submit_run_streaming_rotation_exactly_once(tmp_path):
+    """ISSUE 19 acceptance: a 2-worker ``dmlc-submit`` job follows a
+    stream whose writer rotates MID-JOB. The trained (order-independent
+    integer) model state and the per-shard content hashes must be
+    bit-identical to a post-hoc read of the sealed shards, every
+    micro-shard must commit exactly once, and the end-of-job report
+    must carry the stream lag column ``tools top`` renders."""
+    from dmlc_core_tpu.telemetry import timeseries as ts
+    from dmlc_core_tpu.tools import _render_top, _top_model
+
+    d = str(tmp_path / "stream")
+    os.makedirs(d)
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir)
+    report_path = tmp_path / "metrics_report.json"
+    script = tmp_path / "worker.py"
+    script.write_text(_STREAM_WORKER.format(repo=REPO, d=d, out=out_dir))
+
+    def produce():
+        with StreamWriter(d, codec=None, rotate_bytes=4096,
+                          commit_records=40) as w:
+            for i in range(N_DRILL):
+                w.append(_payload(i))
+                time.sleep(0.004)  # rotations land while workers drain
+
+    t = threading.Thread(target=produce)
+    t.start()
+    try:
+        run = subprocess.run(
+            [sys.executable, "-m", "dmlc_core_tpu.tracker.submit",
+             "--cluster", "local", "--num-workers", "2",
+             "--host-ip", "127.0.0.1",
+             sys.executable, str(script)],
+            capture_output=True, text=True, timeout=150,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "DMLC_TS_INTERVAL": "0.1",
+                 "DMLC_METRICS_REPORT": str(report_path)},
+            cwd=REPO,
+        )
+    finally:
+        t.join()
+    assert run.returncode == 0, run.stderr[-3000:]
+
+    outs = [json.load(open(os.path.join(out_dir, f)))
+            for f in sorted(os.listdir(out_dir))]
+    assert len(outs) == 2 and {o["rank"] for o in outs} == {0, 1}
+
+    # the sealed truth: every record landed in exactly one sealed shard
+    m = sm.read_manifest(d)
+    assert m["eos"] is True and len(m["sealed"]) >= 3, (
+        "the writer never rotated mid-job")
+    sealed_by_gen = {}
+    nxt = 0
+    for ent in m["sealed"]:
+        recs = [_payload(i) for i in range(nxt, nxt + ent["records"])]
+        sealed_by_gen[str(ent["gen"])] = sorted(
+            zlib.crc32(r) for r in recs)
+        nxt += ent["records"]
+    assert nxt == N_DRILL
+
+    # exactly-once at record level: the union of both workers' records
+    # is the corpus, no duplicates, none lost
+    assert sum(o["rows"] for o in outs) == N_DRILL
+    consumed = sorted(c for o in outs for v in o["by_gen"].values()
+                      for c in v)
+    assert consumed == sorted(c for v in sealed_by_gen.values() for c in v)
+
+    # per-shard content hashes bit-identical to the sealed reads
+    for gen, want in sealed_by_gen.items():
+        got = sorted(c for o in outs for c in o["by_gen"].get(gen, []))
+        assert got == want, f"generation {gen} content drifted"
+
+    # trained model state bit-identical (order-independent integers)
+    assert sum(o["theta"] for o in outs) == sum(
+        c for v in sealed_by_gen.values() for c in v)
+
+    # every micro-shard committed exactly once cluster-wide
+    recorded = [tuple(e[:2]) for o in outs for e in o["events"]
+                if e[2] == "recorded"]
+    assert len(recorded) == len(set(recorded)), "a micro-shard double-committed"
+    assert len(recorded) > 0
+    gens_done = {g for g, _ in recorded}
+    assert gens_done == set(int(g) for g in sealed_by_gen), (
+        "some generation finished without a recorded micro-shard")
+
+    # the report carries the stream staleness family and tools top
+    # renders the live lag column from it
+    report = json.loads(report_path.read_text())
+    per_rank = report["timeseries"]["per_rank"]
+    assert {"0", "1"} <= set(per_rank)
+    views = {r: ts.windowed(per_rank[r], 120.0) for r in per_rank}
+    lagged = [r for r in ("0", "1")
+              if "stream_lag_seconds" in views[r]["derived"]]
+    assert lagged, "no rank shipped stream.* gauges"
+    model = _top_model(
+        {"windowed": {"per_rank": views,
+                      "cluster": ts.merge_windows(views)}}, 120.0)
+    txt = _render_top(model, "127.0.0.1:9999")
+    assert "stream lag" in txt and "lag" in txt

@@ -163,6 +163,25 @@ Checks (each finding is `path:line: code message`, exit 1 on any):
                                  hygiene and teardown policy per call
                                  site; serve through those two or live
                                  outside io/.)
+  L020 stream manifest literal / tail-frame walk in dmlc_core_tpu/
+                                 (the streaming commit point is a
+                                 single-site concern: stream/
+                                 manifest.py owns the "manifest.json"
+                                 filename (MANIFEST_NAME), the atomic-
+                                 rename read/write pair, and the
+                                 decode_length-driven frame walks —
+                                 whole_record_prefix / walk_frames /
+                                 scan_committed_prefix / count_records
+                                 — that decide where a growing shard's
+                                 committed prefix ends. A filename
+                                 literal elsewhere can drift against
+                                 the publisher; a second frame walk
+                                 can disagree about where the torn
+                                 tail starts and read uncommitted
+                                 bytes. Spell the name via
+                                 MANIFEST_NAME and walk frames through
+                                 manifest.py's helpers; docstrings
+                                 mentioning the filename are fine.)
 
 Run: python tools/lint.py [paths...]   (default: the repo's source roots)
 """
@@ -426,6 +445,13 @@ _L010_EXEMPT = ("/io/blockcache.py", "/io/lookup.py")
 # minted anywhere) and exempts the one sanctioned construction site
 _L019_SCOPE_DIRS = ("dmlc_core_tpu/",)
 _L019_EXEMPT = ("/io/shm.py",)
+# L020 is scoped to the WHOLE library (a manifest path could be spelled
+# anywhere a stream is opened) and exempts the one sanctioned site for
+# the filename, the atomic read/write pair and the tail-frame walks.
+# recordio.py DEFINES decode_length — definitions aren't imports, so it
+# needs no exemption.
+_L020_SCOPE_DIRS = ("dmlc_core_tpu/",)
+_L020_EXEMPT = ("/stream/manifest.py",)
 # L016 is scoped to dmlc_core_tpu/io/ and exempts the same two files —
 # the only modules allowed to RUN a socket-serving request loop there
 _L016_SCOPE_DIRS = ("dmlc_core_tpu/io/",)
@@ -905,6 +931,97 @@ def _check_journal_crc_framing(tree: ast.Module) -> Iterator[Tuple[int, str]]:
             )
 
 
+_MANIFEST_NAME = "manifest.json"
+
+
+def _docstring_consts(tree: ast.Module) -> set:
+    """id()s of the Constant nodes that are module/class/function
+    docstrings — prose ABOUT the manifest is not a second spelling of
+    its path."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(id(body[0].value))
+    return out
+
+
+def _check_stream_manifest_framing(tree: ast.Module) -> Iterator[Tuple[int, str]]:
+    """Two spellings of the streaming commit point outside stream/
+    manifest.py, mirroring the L006/L008-L019 single-site pattern:
+    (a) a ``"manifest.json"`` string literal (incl. f-string parts) —
+    hand-rolled manifest paths bypass the atomic-rename publisher and
+    can drift the filename; import ``MANIFEST_NAME`` instead (the
+    imported constant is the sanctioned alias and never flags); and
+    (b) any import or alias-aware use of ``decode_length`` from the
+    recordio module — the lrec length accessor only matters to a frame
+    WALK (advance = 8 + pad4(length)), and tail-frame walks that
+    decide where a growing shard's committed prefix ends live in
+    manifest.py (whole_record_prefix / walk_frames /
+    scan_committed_prefix / count_records). Sniffing a frame's FLAG
+    (staging/fused.py's compression probe) doesn't need the length and
+    stays quiet. Docstrings are ignored. Scoped in lint_file."""
+    doc_ids = _docstring_consts(tree)
+    lit_msg = (
+        'stream manifest filename literal (the commit-point path is '
+        "spelled once, stream/manifest.py's MANIFEST_NAME — a second "
+        "spelling can drift against the atomic-rename publisher)"
+    )
+    walk_msg = (
+        "RecordIO tail-frame walking outside stream/manifest.py "
+        "(decode_length-driven walks decide where the committed "
+        "prefix ends; a second walk can disagree about the torn "
+        "tail and read uncommitted bytes — use manifest.py's "
+        "whole_record_prefix/walk_frames/count_records)"
+    )
+    fn_aliases = set()
+    mod_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.rpartition(".")[2] == "recordio":
+                for alias in node.names:
+                    if alias.name == "decode_length":
+                        yield node.lineno, walk_msg
+                        fn_aliases.add(alias.asname or alias.name)
+            elif any(a.name == "recordio" for a in node.names):
+                for alias in node.names:
+                    if alias.name == "recordio":
+                        mod_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.rpartition(".")[2] == "recordio":
+                    mod_aliases.add(
+                        alias.asname or alias.name.partition(".")[0]
+                    )
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant):
+            if (
+                isinstance(node.value, str)
+                and _MANIFEST_NAME in node.value
+                and id(node) not in doc_ids
+            ):
+                yield node.lineno, lit_msg
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Name) and f.id in fn_aliases) or (
+                isinstance(f, ast.Attribute)
+                and f.attr == "decode_length"
+                and isinstance(f.value, ast.Name)
+                and f.value.id in mod_aliases
+            ):
+                yield node.lineno, walk_msg
+
+
 CHECKS = [
     ("L001", _check_unused_imports),
     ("L002", _check_bare_except),
@@ -925,6 +1042,7 @@ CHECKS = [
     ("L017", _check_trace_context_codec),
     ("L018", _check_journal_crc_framing),
     ("L019", _check_shm_segment_construction),
+    ("L020", _check_stream_manifest_framing),
 ]
 
 
@@ -1051,6 +1169,15 @@ def lint_file(path: Path) -> List[Finding]:
                 rel_posix.startswith(_L019_SCOPE_DIRS)
                 if in_repo
                 else any("/" + d in posix for d in _L019_SCOPE_DIRS)
+            ):
+                continue
+        if code == "L020":
+            if posix.endswith(_L020_EXEMPT):
+                continue
+            if not (
+                rel_posix.startswith(_L020_SCOPE_DIRS)
+                if in_repo
+                else any("/" + d in posix for d in _L020_SCOPE_DIRS)
             ):
                 continue
         for line, msg in fn(tree):
